@@ -1,0 +1,255 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+The capabilities of Ray (tasks, actors, distributed objects, placement
+groups, ML libraries) re-designed for TPU clusters: JAX/XLA/pjit/Pallas for
+compute, XLA collectives over ICI/DCN for the SPMD plane, a native
+shared-memory object store for the host data plane, and slice-aware
+scheduling.
+
+Public API (reference: python/ray/_private/worker.py — init:1108, get:2410,
+put:2519, wait:2582, kill:2748, cancel:2779, remote:2925):
+
+    import ray_tpu
+
+    ray_tpu.init()
+
+    @ray_tpu.remote
+    def f(x): return x * 2
+
+    ray_tpu.get(f.remote(2))  # -> 4
+
+Subpackages (imported lazily; none of them load jax at import time):
+    ray_tpu.parallel — device mesh + DP/FSDP/TP/PP/SP/EP sharding presets
+    ray_tpu.models   — flagship model zoo (llama, gpt2, moe)
+    ray_tpu.ops      — Pallas kernels (flash/ring attention, ...)
+    ray_tpu.train    — distributed Trainer (JaxTrainer)
+    ray_tpu.data     — streaming datasets
+    ray_tpu.tune     — hyperparameter search
+    ray_tpu.serve    — model serving
+    ray_tpu.rl       — RL (TPU learner / CPU rollout split)
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu.core import runtime as _rt
+from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor, method
+from ray_tpu.core.common import ObjectRef, ResourceSet
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import JobID
+from ray_tpu.core.node import (detect_tpu_chips, new_session_dir, start_gcs,
+                               start_nodelet)
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core import status as exceptions
+
+__version__ = "0.1.0"
+
+_init_lock = threading.Lock()
+_session: Optional[dict] = None
+
+
+def is_initialized() -> bool:
+    return _rt.current_runtime_or_none() is not None
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         namespace: str = "default",
+         ignore_reinit_error: bool = False,
+         _system_config: Optional[Dict[str, Any]] = None) -> dict:
+    """Start (or connect to) a ray_tpu cluster.
+
+    address=None starts a new local cluster (gcs + one nodelet);
+    address="host:port" connects to an existing GCS.
+    ref: worker.py:1108 init / node.py:1148 start_head_processes.
+    """
+    global _session
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return dict(_session or {})
+            raise RuntimeError("ray_tpu.init() already called")
+        cfg = Config.load(_system_config)
+        procs = []
+        if address is None:
+            session_dir = new_session_dir()
+            gcs_proc, gcs_addr = start_gcs(session_dir, cfg)
+            procs.append(gcs_proc)
+            res = dict(resources or {})
+            res.setdefault("CPU", float(num_cpus if num_cpus is not None
+                                        else (os.cpu_count() or 1)))
+            chips = num_tpus if num_tpus is not None else detect_tpu_chips()
+            if chips:
+                res.setdefault("TPU", float(chips))
+            nodelet_proc, nodelet_addr, node_id_hex, store_name = start_nodelet(
+                session_dir, cfg, gcs_addr, resources=res)
+            procs.append(nodelet_proc)
+        else:
+            session_dir = os.environ.get("RAY_TPU_SESSION_DIR", new_session_dir())
+            h, p = address.rsplit(":", 1)
+            gcs_addr = (h, int(p))
+            # find a local nodelet via GCS (pick any alive node on 127.0.0.1;
+            # multi-host drivers would match on hostname)
+            import asyncio
+
+            from ray_tpu.core.rpc import RpcClient
+
+            async def _nodes():
+                c = RpcClient(*gcs_addr)
+                try:
+                    return await c.call("get_nodes", timeout=cfg.rpc_connect_timeout_s)
+                finally:
+                    await c.close()
+            nodes = asyncio.run(_nodes())
+            alive = [n for n in nodes if n.alive]
+            if not alive:
+                raise RuntimeError(f"no alive nodes at {address}")
+            nodelet_addr = alive[0].nodelet_addr
+            store_name = alive[0].store_name
+
+        job_id = JobID.from_random()
+        runtime = _rt.Runtime(cfg, gcs_addr, nodelet_addr, store_name, job_id,
+                              mode="driver")
+        _rt.set_runtime(runtime)
+        runtime.start()
+        runtime.gcs_call("add_job", job_id=job_id, driver_addr=runtime.address.addr,
+                         meta={"namespace": namespace, "pid": os.getpid()})
+        _session = {
+            "address": f"{gcs_addr[0]}:{gcs_addr[1]}",
+            "session_dir": session_dir,
+            "node_addr": nodelet_addr,
+            "namespace": namespace,
+            "procs": procs,
+            "job_id": job_id,
+        }
+        atexit.register(shutdown)
+        return dict(_session)
+
+
+def shutdown():
+    """Stop the runtime; kill daemons we started (ref: ray.shutdown)."""
+    global _session
+    with _init_lock:
+        runtime = _rt.current_runtime_or_none()
+        if runtime is not None:
+            try:
+                runtime.flush_task_events()
+                runtime.gcs_call("finish_job", job_id=runtime.job_id, rpc_timeout=2.0)
+            except Exception:
+                pass
+            runtime.shutdown()
+        if _session:
+            for p in _session.get("procs", []):
+                try:
+                    p.terminate()
+                except Exception:
+                    pass
+            for p in _session.get("procs", []):
+                try:
+                    p.wait(timeout=3)
+                except Exception:
+                    try:
+                        p.kill()
+                    except Exception:
+                        pass
+            _session = None
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+
+
+def remote(*args, **options):
+    """@ray_tpu.remote / @ray_tpu.remote(**options) on functions or classes."""
+    def make(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return make(args[0])
+    if args:
+        raise TypeError("@ray_tpu.remote takes keyword options only")
+    return make
+
+
+def put(value: Any) -> ObjectRef:
+    return _rt.get_runtime().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    runtime = _rt.get_runtime()
+    if isinstance(refs, ObjectRef):
+        return runtime.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"ray_tpu.get expects ObjectRef or list, got {type(refs)}")
+    return runtime.get(list(refs), timeout=timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None):
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError("ray_tpu.wait expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return _rt.get_runtime().wait(list(refs), num_returns=num_returns,
+                                  timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _rt.get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    raise NotImplementedError(
+        "task cancellation lands with the cancellation milestone")
+
+
+def nodes() -> List[dict]:
+    out = []
+    for n in _rt.get_runtime().gcs_call("get_nodes"):
+        out.append({"NodeID": n.node_id.hex(), "Alive": n.alive,
+                    "Resources": n.resources_total.quantities,
+                    "Labels": n.labels, "NodeletAddress": n.nodelet_addr,
+                    "StoreName": n.store_name})
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in _rt.get_runtime().gcs_call("get_nodes"):
+        if not n.alive:
+            continue
+        for k, v in n.resources_total.quantities.items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for _, q in _rt.get_runtime().gcs_call("get_available_resources").items():
+        for k, v in q.items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def timeline(limit: int = 1000) -> List[dict]:
+    """Recent task state transitions from the GCS task-event store
+    (ref: `ray timeline` scripts.py:1835)."""
+    return _rt.get_runtime().gcs_call("list_task_events", limit=limit)
+
+
+__all__ = [
+    "init", "shutdown", "remote", "put", "get", "wait", "kill", "cancel",
+    "method", "get_actor", "nodes", "cluster_resources", "available_resources",
+    "timeline", "ObjectRef", "ActorHandle", "exceptions", "is_initialized",
+    "__version__",
+]

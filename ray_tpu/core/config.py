@@ -1,0 +1,92 @@
+"""Runtime configuration flags.
+
+Reference: src/ray/common/ray_config_def.h:18 — a single macro table of
+RAY_CONFIG(type, name, default) entries, overridable via RAY_<NAME> env vars
+or a serialized system-config dict handed down from `init()`. We reproduce
+the same three-layer precedence (default < env RAY_TPU_<NAME> < explicit
+_system_config) with a plain dataclass-of-record table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+
+@dataclass
+class Config:
+    # --- object store -------------------------------------------------------
+    object_store_memory: int = 2 * 1024**3       # host shm tier bytes
+    object_store_max_objects: int = 1 << 15
+    # Objects <= this many bytes take the in-process memory-store path and are
+    # inlined into task replies (ref: RayConfig max_direct_call_object_size).
+    max_direct_call_object_size: int = 100 * 1024
+    object_transfer_chunk_bytes: int = 8 * 1024**2  # ref: 64MiB gRPC chunks; we
+                                                    # default smaller for 1-host
+    # --- scheduler / raylet -------------------------------------------------
+    worker_lease_timeout_s: float = 30.0
+    worker_pool_prestart: int = 0
+    max_workers_per_node: int = 8
+    worker_idle_timeout_s: float = 300.0
+    scheduler_spread_threshold: float = 0.5      # ref: RAY_scheduler_spread_threshold
+    scheduler_top_k_fraction: float = 0.2        # ref: hybrid_scheduling_policy.h:29
+    # --- health / failure detection -----------------------------------------
+    health_check_period_s: float = 1.0           # ref: ray_config_def.h:793-799
+    health_check_timeout_s: float = 5.0
+    health_check_failure_threshold: int = 5
+    actor_max_restarts_default: int = 0
+    task_max_retries_default: int = 3
+    # --- gcs ----------------------------------------------------------------
+    gcs_storage: str = "memory"                  # "memory" | "file" (ft restart)
+    gcs_file_storage_path: str = ""
+    # --- timeouts -----------------------------------------------------------
+    rpc_connect_timeout_s: float = 10.0
+    get_timeout_warn_s: float = 10.0
+    # --- workers ------------------------------------------------------------
+    worker_start_timeout_s: float = 60.0
+    # --- tpu ----------------------------------------------------------------
+    # Logical chip resource name; slice-aware gang scheduling reserves whole
+    # ICI-connected shapes (SURVEY.md section 7 "hard parts").
+    chip_resource: str = "TPU"
+    # --- observability ------------------------------------------------------
+    task_event_buffer_size: int = 10000          # ref: task_event_buffer.h:199
+    metrics_report_interval_s: float = 5.0
+    log_to_driver: bool = True
+
+    def override(self, d: Dict[str, Any]) -> "Config":
+        for k, v in d.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown config key: {k}")
+            setattr(self, k, v)
+        return self
+
+    @classmethod
+    def load(cls, system_config: Dict[str, Any] | None = None) -> "Config":
+        cfg = cls()
+        for f in fields(cls):
+            env = os.environ.get(f"RAY_TPU_{f.name.upper()}")
+            if env is not None:
+                cur = getattr(cfg, f.name)
+                if isinstance(cur, bool):
+                    setattr(cfg, f.name, env.lower() in ("1", "true", "yes"))
+                elif isinstance(cur, int):
+                    setattr(cfg, f.name, int(env))
+                elif isinstance(cur, float):
+                    setattr(cfg, f.name, float(env))
+                else:
+                    setattr(cfg, f.name, env)
+        if system_config:
+            cfg.override(system_config)
+        return cfg
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls().override(json.loads(s))
+
+
+GLOBAL_CONFIG: Config = Config.load()

@@ -1,0 +1,647 @@
+"""Cluster control plane ("GCS").
+
+Reference: src/ray/gcs/gcs_server/gcs_server.cc:192-237 wires the same
+subsystems this module holds in one asyncio process:
+
+- node membership + passive health checks (ref: GcsNodeManager,
+  GcsHealthCheckManager; thresholds ray_config_def.h:793-799)
+- resource view fed by nodelet heartbeats (ref: RaySyncer gossip — here a
+  star topology: every nodelet reports (seqno, available) each period)
+- actor manager with restart FSM and named-actor registry
+  (ref: gcs_actor_manager.cc:246,271,1100)
+- placement groups with two-phase PREPARE/COMMIT reservation across nodelets
+  (ref: gcs_placement_group_scheduler.h)
+- internal KV (ref: gcs_kv_manager.h) — also the function/class code store
+  (ref: function_manager.py:61 exports via GCS KV)
+- job table, task-event sink (ref: gcs_task_manager.h), pub/sub push
+  (ref: src/ray/pubsub/)
+
+Storage is a pluggable snapshot: "memory" (default) or "file" (pickle
+snapshot for GCS restart; ref: GcsTableStorage memory/Redis backends).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pickle
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.common import (Address, NodeInfo, ResourceSet, TaskSpec)
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.core.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+# Actor FSM states (ref: rpc::ActorTableData::ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class ActorRecord:
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.actor_id: ActorID = spec.actor_id
+        self.state = PENDING_CREATION
+        self.address: Optional[Address] = None      # worker RPC address
+        self.node_id: Optional[NodeID] = None
+        self.worker_id: bytes = b""
+        self.num_restarts = 0
+        self.max_restarts = spec.max_restarts
+        self.name = spec.actor_name
+        self.namespace = spec.namespace
+        self.death_cause: str = ""
+
+    def view(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id,
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "name": self.name,
+            "namespace": self.namespace,
+            "death_cause": self.death_cause,
+            "class_name": self.spec.name,
+        }
+
+
+class GcsServer:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.available: Dict[NodeID, ResourceSet] = {}
+        self.heartbeat_seq: Dict[NodeID, int] = {}
+        self.last_seen: Dict[NodeID, float] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.jobs: Dict[JobID, dict] = {}
+        self.kv: Dict[Tuple[str, bytes], bytes] = {}
+        self.pgs: Dict[PlacementGroupID, dict] = {}
+        self.subscribers: Dict[str, set] = defaultdict(set)  # channel -> {addr}
+        self.task_events: deque = deque(maxlen=cfg.task_event_buffer_size)
+        self.pool = ClientPool()
+        self.server = RpcServer(self)
+        self._round_robin = 0
+        self._stopping = False
+
+    # ------------------------------------------------------------------ boot
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        self.server.host, self.server.port = host, port
+        addr = await self.server.start()
+        self._maybe_restore()
+        asyncio.get_running_loop().create_task(self._health_loop())
+        return addr
+
+    async def _health_loop(self):
+        period = self.cfg.health_check_period_s
+        timeout = period * self.cfg.health_check_failure_threshold
+        while not self._stopping:
+            await asyncio.sleep(period)
+            now = time.time()
+            for nid, info in list(self.nodes.items()):
+                if info.alive and now - self.last_seen.get(nid, now) > timeout:
+                    await self._on_node_death(nid, "health check timeout")
+
+    async def _on_node_death(self, node_id: NodeID, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        self.available.pop(node_id, None)
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        await self._publish("node", {"node_id": node_id, "alive": False})
+        # Restart actors that lived there (ref: gcs_actor_manager.cc:1100).
+        for rec in list(self.actors.values()):
+            if rec.node_id == node_id and rec.state == ALIVE:
+                await self._reconstruct_actor(rec, f"node died: {reason}")
+        # Release placement-group bundles on the dead node; PGs with STRICT
+        # placement become (partially) unplaced — reschedule best-effort.
+        for pgid, pg in self.pgs.items():
+            changed = False
+            for b in pg["bundles"]:
+                if b.get("node_id") == node_id:
+                    b["node_id"] = None
+                    changed = True
+            if changed:
+                await self._try_place_pg(pgid)
+
+    # -------------------------------------------------------------- membership
+
+    async def rpc_register_node(self, info: NodeInfo) -> dict:
+        self.nodes[info.node_id] = info
+        self.available[info.node_id] = info.resources_total.copy()
+        self.last_seen[info.node_id] = time.time()
+        await self._publish("node", {"node_id": info.node_id, "alive": True})
+        return {"ok": True, "config": self.cfg.to_json()}
+
+    async def rpc_heartbeat(self, node_id: NodeID, seqno: int,
+                            available: ResourceSet) -> dict:
+        # ref: ray_syncer.h versioned snapshots — stale seqnos are dropped.
+        if seqno >= self.heartbeat_seq.get(node_id, -1):
+            self.heartbeat_seq[node_id] = seqno
+            if node_id in self.nodes:
+                self.available[node_id] = available
+        self.last_seen[node_id] = time.time()
+        info = self.nodes.get(node_id)
+        if info is not None and not info.alive:
+            # Node came back (e.g. transient stall) — reference treats this as
+            # a new node; we resurrect membership.
+            info.alive = True
+            await self._publish("node", {"node_id": node_id, "alive": True})
+        return {"ok": True}
+
+    async def rpc_drain_node(self, node_id: NodeID) -> dict:
+        await self._on_node_death(node_id, "drained")
+        return {"ok": True}
+
+    async def rpc_get_nodes(self) -> List[NodeInfo]:
+        return list(self.nodes.values())
+
+    async def rpc_get_available_resources(self) -> Dict[bytes, Dict[str, float]]:
+        return {nid.binary(): rs.quantities for nid, rs in self.available.items()}
+
+    # ------------------------------------------------------------- scheduling
+
+    def _feasible_nodes(self, resources: ResourceSet,
+                        exclude: Optional[set] = None) -> List[Tuple[NodeID, NodeInfo]]:
+        out = []
+        for nid, info in self.nodes.items():
+            if not info.alive or (exclude and nid in exclude):
+                continue
+            if resources.fits_in(self.available.get(nid, ResourceSet())):
+                out.append((nid, info))
+        return out
+
+    async def rpc_pick_node(self, resources: ResourceSet, strategy_kind: str = "DEFAULT",
+                            exclude: Optional[list] = None) -> Optional[dict]:
+        """Spillback target selection (ref: ClusterResourceScheduler::
+        GetBestSchedulableNode, cluster_resource_scheduler.cc:129).
+
+        DEFAULT approximates the hybrid policy: prefer packing onto nodes with
+        utilization below the spread threshold, else least-utilized. SPREAD is
+        round-robin over feasible nodes (ref: scheduling_policy.cc spread)."""
+        exclude_set = set(exclude) if exclude else None
+        cands = self._feasible_nodes(resources, exclude_set)
+        if not cands:
+            return None
+        if strategy_kind == "SPREAD":
+            self._round_robin += 1
+            nid, info = cands[self._round_robin % len(cands)]
+        else:
+            def utilization(nid):
+                total = self.nodes[nid].resources_total.quantities
+                avail = self.available[nid].quantities
+                cpu_t = total.get("CPU", 1.0) or 1.0
+                return 1.0 - avail.get("CPU", 0.0) / cpu_t
+            below = [c for c in cands if utilization(c[0]) < self.cfg.scheduler_spread_threshold]
+            pool = below or cands
+            nid, info = min(pool, key=lambda c: utilization(c[0]))
+        return {"node_id": nid, "addr": info.nodelet_addr}
+
+    # ------------------------------------------------------------------ actors
+
+    async def rpc_register_actor(self, spec: TaskSpec) -> dict:
+        """ref: gcs_actor_manager.cc:246 RegisterActor."""
+        if spec.actor_name:
+            key = (spec.namespace, spec.actor_name)
+            if key in self.named_actors:
+                existing = self.actors[self.named_actors[key]]
+                if existing.state != DEAD:
+                    return {"ok": False, "error": f"actor name {key} taken"}
+            self.named_actors[key] = spec.actor_id
+        rec = ActorRecord(spec)
+        self.actors[spec.actor_id] = rec
+        asyncio.get_running_loop().create_task(self._create_actor(rec))
+        return {"ok": True}
+
+    async def _create_actor(self, rec: ActorRecord):
+        """Lease a worker somewhere and push the creation task
+        (ref: gcs_actor_scheduler.h lease-based actor scheduling)."""
+        spec = rec.spec
+        deadline = time.time() + self.cfg.worker_lease_timeout_s * 10
+        while not self._stopping:
+            target = await self._pick_for_spec(spec)
+            if target is None:
+                if time.time() > deadline:
+                    rec.state = DEAD
+                    rec.death_cause = "no feasible node for actor resources"
+                    await self._publish_actor(rec)
+                    return
+                await asyncio.sleep(0.2)
+                continue
+            nid = target["node_id"]
+            client = self.pool.get(tuple(target["addr"]))
+            try:
+                r = await client.call("create_actor", spec=spec)
+            except (ConnectionLost, RemoteError, OSError) as e:
+                logger.warning("actor create on %s failed: %s", nid.hex()[:8], e)
+                await asyncio.sleep(0.2)
+                continue
+            if not r.get("ok"):
+                if r.get("retryable", True):
+                    await asyncio.sleep(0.2)
+                    continue
+                rec.state = DEAD
+                rec.death_cause = r.get("error", "creation failed")
+                await self._publish_actor(rec)
+                return
+            rec.state = ALIVE
+            rec.address = tuple(r["worker_addr"])
+            rec.worker_id = r["worker_id"]
+            rec.node_id = nid
+            await self._publish_actor(rec)
+            return
+
+    async def _pick_for_spec(self, spec: TaskSpec) -> Optional[dict]:
+        if spec.scheduling.kind == "PLACEMENT_GROUP":
+            pg = self.pgs.get(spec.scheduling.pg_id)
+            if pg is None:
+                return None
+            idx = spec.scheduling.bundle_index
+            bundles = pg["bundles"]
+            cands = [bundles[idx]] if idx >= 0 else bundles
+            for b in cands:
+                if b.get("node_id") is not None:
+                    info = self.nodes.get(b["node_id"])
+                    if info and info.alive:
+                        return {"node_id": b["node_id"], "addr": info.nodelet_addr}
+            return None
+        if spec.scheduling.kind == "NODE_AFFINITY":
+            info = self.nodes.get(spec.scheduling.node_id)
+            if info and info.alive:
+                return {"node_id": info.node_id, "addr": info.nodelet_addr}
+            if not spec.scheduling.soft:
+                return None
+        return await self.rpc_pick_node(resources=spec.resources,
+                                        strategy_kind=spec.scheduling.kind)
+
+    async def _reconstruct_actor(self, rec: ActorRecord, cause: str):
+        """ref: gcs_actor_manager.cc:1100 ReconstructActor."""
+        unlimited = rec.max_restarts < 0
+        if not unlimited and rec.num_restarts >= rec.max_restarts:
+            rec.state = DEAD
+            rec.death_cause = cause
+            await self._publish_actor(rec)
+            return
+        rec.num_restarts += 1
+        rec.state = RESTARTING
+        rec.address = None
+        await self._publish_actor(rec)
+        await self._create_actor(rec)
+
+    async def rpc_report_worker_death(self, worker_id: bytes, node_id: NodeID,
+                                      intentional: bool = False,
+                                      reason: str = "worker died") -> dict:
+        for rec in list(self.actors.values()):
+            if rec.worker_id == worker_id and rec.state == ALIVE:
+                if intentional:
+                    rec.state = DEAD
+                    rec.death_cause = reason
+                    await self._publish_actor(rec)
+                else:
+                    await self._reconstruct_actor(rec, reason)
+        return {"ok": True}
+
+    async def rpc_kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> dict:
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return {"ok": False, "error": "no such actor"}
+        if no_restart:
+            rec.max_restarts = rec.num_restarts  # exhaust budget
+        if rec.address is not None and rec.node_id in self.nodes:
+            client = self.pool.get(self.nodes[rec.node_id].nodelet_addr)
+            try:
+                await client.call("kill_worker", worker_id=rec.worker_id,
+                                  reason="ray_tpu.kill")
+            except (ConnectionLost, RemoteError, OSError):
+                pass
+        if no_restart:
+            rec.state = DEAD
+            rec.death_cause = "killed via ray_tpu.kill"
+            await self._publish_actor(rec)
+        return {"ok": True}
+
+    async def rpc_get_actor(self, actor_id: ActorID) -> Optional[dict]:
+        rec = self.actors.get(actor_id)
+        return rec.view() if rec else None
+
+    async def rpc_get_named_actor(self, name: str, namespace: str = "default") -> Optional[dict]:
+        aid = self.named_actors.get((namespace, name))
+        if aid is None:
+            return None
+        rec = self.actors.get(aid)
+        if rec is None or rec.state == DEAD:
+            return None
+        return {"spec": rec.spec, "view": rec.view()}
+
+    async def rpc_list_actors(self) -> List[dict]:
+        return [r.view() for r in self.actors.values()]
+
+    async def rpc_wait_actor_alive(self, actor_id: ActorID, wait_timeout: float = 30.0) -> dict:
+        deadline = time.time() + wait_timeout
+        while time.time() < deadline:
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec.state == ALIVE:
+                return {"ok": True, "view": rec.view()}
+            if rec is not None and rec.state == DEAD:
+                return {"ok": False, "view": rec.view()}
+            await asyncio.sleep(0.05)
+        return {"ok": False, "view": None}
+
+    async def _publish_actor(self, rec: ActorRecord):
+        await self._publish(f"actor:{rec.actor_id.hex()}", rec.view())
+        self._maybe_snapshot()
+
+    # -------------------------------------------------------- placement groups
+
+    async def rpc_create_placement_group(self, pg_id: PlacementGroupID,
+                                         bundles: List[ResourceSet],
+                                         strategy: str = "PACK",
+                                         name: str = "") -> dict:
+        """2-phase reservation across nodelets
+        (ref: gcs_placement_group_scheduler.h PREPARE/COMMIT)."""
+        self.pgs[pg_id] = {
+            "pg_id": pg_id,
+            "bundles": [{"resources": b, "node_id": None, "index": i}
+                        for i, b in enumerate(bundles)],
+            "strategy": strategy,
+            "name": name,
+            "state": "PENDING",
+        }
+        ok = await self._try_place_pg(pg_id)
+        return {"ok": ok, "state": self.pgs[pg_id]["state"]}
+
+    async def _try_place_pg(self, pg_id: PlacementGroupID) -> bool:
+        pg = self.pgs[pg_id]
+        strategy = pg["strategy"]
+        unplaced = [b for b in pg["bundles"] if b["node_id"] is None]
+        if not unplaced:
+            pg["state"] = "CREATED"
+            return True
+        # Phase 0: pick nodes for every unplaced bundle against a scratch view.
+        scratch = {nid: rs.copy() for nid, rs in self.available.items()
+                   if self.nodes[nid].alive}
+        placed_on_by_strict = set(
+            b["node_id"] for b in pg["bundles"] if b["node_id"] is not None)
+        plan: List[Tuple[dict, NodeID]] = []
+        for b in unplaced:
+            req: ResourceSet = b["resources"]
+            cands = [nid for nid, avail in scratch.items() if req.fits_in(avail)]
+            if strategy == "STRICT_SPREAD":
+                used = placed_on_by_strict | {nid for _, nid in plan}
+                cands = [c for c in cands if c not in used]
+            if not cands:
+                pg["state"] = "PENDING"
+                return False
+            if strategy in ("PACK", "STRICT_PACK"):
+                used = placed_on_by_strict | {nid for _, nid in plan}
+                packed = [c for c in cands if c in used]
+                nid = (packed or cands)[0]
+            elif strategy in ("SPREAD", "STRICT_SPREAD"):
+                counts = defaultdict(int)
+                for _, n in plan:
+                    counts[n] += 1
+                nid = min(cands, key=lambda c: counts[c])
+            else:
+                nid = cands[0]
+            if strategy == "STRICT_PACK":
+                all_nodes = placed_on_by_strict | {n for _, n in plan} | {nid}
+                if len(all_nodes) > 1:
+                    pg["state"] = "PENDING"
+                    return False
+            scratch[nid].subtract(req)
+            plan.append((b, nid))
+        # Phase 1: PREPARE on each nodelet.
+        prepared: List[Tuple[dict, NodeID]] = []
+        for b, nid in plan:
+            client = self.pool.get(self.nodes[nid].nodelet_addr)
+            try:
+                r = await client.call("pg_prepare", pg_id=pg_id, bundle_index=b["index"],
+                                      resources=b["resources"])
+            except (ConnectionLost, RemoteError, OSError):
+                r = {"ok": False}
+            if not r.get("ok"):
+                for pb, pnid in prepared:  # rollback
+                    try:
+                        await self.pool.get(self.nodes[pnid].nodelet_addr).call(
+                            "pg_return", pg_id=pg_id, bundle_index=pb["index"])
+                    except Exception:
+                        pass
+                pg["state"] = "PENDING"
+                return False
+            prepared.append((b, nid))
+        # Phase 2: COMMIT.
+        for b, nid in prepared:
+            try:
+                await self.pool.get(self.nodes[nid].nodelet_addr).call(
+                    "pg_commit", pg_id=pg_id, bundle_index=b["index"])
+            except (ConnectionLost, RemoteError, OSError):
+                pass
+            b["node_id"] = nid
+        pg["state"] = "CREATED"
+        await self._publish(f"pg:{pg_id.hex()}", {"state": "CREATED"})
+        return True
+
+    async def rpc_remove_placement_group(self, pg_id: PlacementGroupID) -> dict:
+        pg = self.pgs.pop(pg_id, None)
+        if pg is None:
+            return {"ok": False}
+        for b in pg["bundles"]:
+            nid = b.get("node_id")
+            if nid is not None and nid in self.nodes:
+                try:
+                    await self.pool.get(self.nodes[nid].nodelet_addr).call(
+                        "pg_return", pg_id=pg_id, bundle_index=b["index"])
+                except Exception:
+                    pass
+        return {"ok": True}
+
+    async def rpc_get_placement_group(self, pg_id: PlacementGroupID) -> Optional[dict]:
+        pg = self.pgs.get(pg_id)
+        if pg is None:
+            return None
+        return {"pg_id": pg_id, "state": pg["state"], "strategy": pg["strategy"],
+                "name": pg["name"],
+                "bundles": [{"index": b["index"], "node_id": b["node_id"],
+                             "resources": b["resources"].quantities}
+                            for b in pg["bundles"]]}
+
+    async def rpc_wait_placement_group(self, pg_id: PlacementGroupID,
+                                       wait_timeout: float = 30.0) -> dict:
+        deadline = time.time() + wait_timeout
+        while time.time() < deadline:
+            pg = self.pgs.get(pg_id)
+            if pg is None:
+                return {"ok": False, "error": "removed"}
+            if pg["state"] == "CREATED":
+                return {"ok": True}
+            await self._try_place_pg(pg_id)
+            if self.pgs[pg_id]["state"] == "CREATED":
+                return {"ok": True}
+            await asyncio.sleep(0.2)
+        return {"ok": False, "error": "timeout"}
+
+    # ---------------------------------------------------------------- jobs/kv
+
+    async def rpc_add_job(self, job_id: JobID, driver_addr: Address, meta: dict) -> dict:
+        self.jobs[job_id] = {"job_id": job_id, "driver": driver_addr,
+                             "meta": meta, "start": time.time(), "end": None}
+        return {"ok": True}
+
+    async def rpc_finish_job(self, job_id: JobID) -> dict:
+        if job_id in self.jobs:
+            self.jobs[job_id]["end"] = time.time()
+        return {"ok": True}
+
+    async def rpc_list_jobs(self) -> List[dict]:
+        return list(self.jobs.values())
+
+    async def rpc_kv_put(self, ns: str, key: bytes, value: bytes,
+                         overwrite: bool = True) -> bool:
+        k = (ns, key)
+        if not overwrite and k in self.kv:
+            return False
+        self.kv[k] = value
+        return True
+
+    async def rpc_kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        return self.kv.get((ns, key))
+
+    async def rpc_kv_del(self, ns: str, key: bytes) -> bool:
+        return self.kv.pop((ns, key), None) is not None
+
+    async def rpc_kv_exists(self, ns: str, key: bytes) -> bool:
+        return (ns, key) in self.kv
+
+    async def rpc_kv_keys(self, ns: str, prefix: bytes = b"") -> List[bytes]:
+        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    # ------------------------------------------------------------- task events
+
+    async def rpc_add_task_events(self, events: List[dict]) -> dict:
+        # ref: gcs_task_manager.h bounded task-event store for observability.
+        self.task_events.extend(events)
+        return {"ok": True}
+
+    async def rpc_list_task_events(self, limit: int = 1000,
+                                   job_id: Optional[JobID] = None) -> List[dict]:
+        out = []
+        for ev in reversed(self.task_events):
+            if job_id is not None and ev.get("job_id") != job_id:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
+
+    # ----------------------------------------------------------------- pubsub
+
+    async def rpc_subscribe(self, channel: str, addr: Address) -> dict:
+        self.subscribers[channel].add(tuple(addr))
+        return {"ok": True}
+
+    async def rpc_unsubscribe(self, channel: str, addr: Address) -> dict:
+        self.subscribers[channel].discard(tuple(addr))
+        return {"ok": True}
+
+    async def rpc_publish(self, channel: str, message: Any) -> dict:
+        await self._publish(channel, message)
+        return {"ok": True}
+
+    async def _publish(self, channel: str, message: Any):
+        dead = []
+        for addr in self.subscribers.get(channel, ()):  # push model
+            try:
+                await self.pool.get(addr).oneway("pubsub_message",
+                                                channel=channel, message=message)
+            except (ConnectionLost, OSError):
+                dead.append(addr)
+        for addr in dead:
+            self.subscribers[channel].discard(addr)
+            self.pool.drop(addr)
+
+    # ------------------------------------------------------------ persistence
+
+    def _snapshot_path(self) -> Optional[str]:
+        if self.cfg.gcs_storage == "file" and self.cfg.gcs_file_storage_path:
+            return os.path.join(self.cfg.gcs_file_storage_path, "gcs_snapshot.pkl")
+        return None
+
+    def _maybe_snapshot(self):
+        path = self._snapshot_path()
+        if not path:
+            return
+        try:
+            with open(path + ".tmp", "wb") as f:
+                pickle.dump({"kv": self.kv, "named_actors": self.named_actors,
+                             "jobs": self.jobs}, f)
+            os.replace(path + ".tmp", path)
+        except Exception:
+            logger.exception("gcs snapshot failed")
+
+    def _maybe_restore(self):
+        path = self._snapshot_path()
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                data = pickle.load(f)
+            self.kv = data.get("kv", {})
+            self.named_actors = data.get("named_actors", {})
+            self.jobs = data.get("jobs", {})
+            logger.info("gcs restored %d kv entries", len(self.kv))
+        except Exception:
+            logger.exception("gcs restore failed")
+
+    async def rpc_ping(self) -> dict:
+        return {"ok": True, "time": time.time()}
+
+    async def rpc_shutdown(self) -> dict:
+        self._stopping = True
+        asyncio.get_running_loop().call_later(0.05, _exit_soon)
+        return {"ok": True}
+
+
+def _exit_soon():
+    os._exit(0)
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--config", default="{}")
+    parser.add_argument("--ready-fd", type=int, default=-1)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[gcs] %(asctime)s %(levelname)s %(message)s")
+    cfg = Config.from_json(args.config)
+
+    async def run():
+        gcs = GcsServer(cfg)
+        host, port = await gcs.start(args.host, args.port)
+        if args.ready_fd >= 0:
+            os.write(args.ready_fd, f"{host}:{port}\n".encode())
+            os.close(args.ready_fd)
+        logger.info("gcs listening on %s:%d", host, port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Node/session bootstrap: spawn gcs + nodelet daemons.
+
+Reference: python/ray/_private/node.py (start_head_processes:1148) and
+services.py (start_gcs_server:1280, start_raylet:1353). Daemons are separate
+OS processes started with a ready-pipe handshake; the session directory holds
+logs and liveness metadata.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core.config import Config
+
+Address = Tuple[str, int]
+
+
+def _spawn_with_ready(cmd, session_dir: str, log_name: str,
+                      timeout: float = 30.0) -> Tuple[subprocess.Popen, str]:
+    """Start a daemon that writes "host:port[:...]\n" to --ready-fd."""
+    rfd, wfd = os.pipe()
+    os.set_inheritable(wfd, True)
+    logdir = os.path.join(session_dir, "logs")
+    os.makedirs(logdir, exist_ok=True)
+    out = open(os.path.join(logdir, log_name + ".out"), "ab")
+    err = open(os.path.join(logdir, log_name + ".err"), "ab")
+    proc = subprocess.Popen(cmd + ["--ready-fd", str(wfd)],
+                            stdout=out, stderr=err, close_fds=False,
+                            start_new_session=True)
+    out.close(); err.close()
+    os.close(wfd)
+    line = b""
+    deadline = time.time() + timeout
+    with os.fdopen(rfd, "rb") as f:
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"{log_name} died at startup; see {logdir}/{log_name}.err")
+            chunk = f.readline()
+            if chunk:
+                line = chunk
+                break
+    if not line:
+        proc.terminate()
+        raise RuntimeError(f"{log_name} did not become ready in {timeout}s")
+    return proc, line.decode().strip()
+
+
+def start_gcs(session_dir: str, cfg: Config, host: str = "127.0.0.1",
+              port: int = 0) -> Tuple[subprocess.Popen, Address]:
+    proc, ready = _spawn_with_ready(
+        [sys.executable, "-m", "ray_tpu.core.gcs", "--host", host,
+         "--port", str(port), "--config", cfg.to_json()],
+        session_dir, "gcs")
+    h, p = ready.rsplit(":", 1)
+    return proc, (h, int(p))
+
+
+def start_nodelet(session_dir: str, cfg: Config, gcs_addr: Address,
+                  resources: Optional[Dict[str, float]] = None,
+                  labels: Optional[Dict[str, Any]] = None,
+                  host: str = "127.0.0.1", port: int = 0,
+                  log_name: str = "nodelet"):
+    proc, ready = _spawn_with_ready(
+        [sys.executable, "-m", "ray_tpu.core.nodelet", "--host", host,
+         "--port", str(port), "--gcs", f"{gcs_addr[0]}:{gcs_addr[1]}",
+         "--session-dir", session_dir,
+         "--resources", json.dumps(resources or {}),
+         "--labels", json.dumps(labels or {}),
+         "--config", cfg.to_json()],
+        session_dir, log_name)
+    h, p, node_id_hex, store_name = ready.split(":", 3)
+    return proc, (h, int(p)), node_id_hex, store_name
+
+
+def new_session_dir() -> str:
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+    path = os.path.join(base, f"session_{int(time.time() * 1000)}_{os.getpid()}")
+    os.makedirs(os.path.join(path, "logs"), exist_ok=True)
+    # convenience symlink like the reference's session_latest
+    latest = os.path.join(base, "session_latest")
+    try:
+        if os.path.islink(latest) or os.path.exists(latest):
+            os.remove(latest)
+        os.symlink(path, latest)
+    except OSError:
+        pass
+    return path
+
+
+def detect_tpu_chips() -> int:
+    """Best-effort local chip count WITHOUT importing jax (daemons must not
+    grab the TPU). Honors explicit override first."""
+    env = os.environ.get("RAY_TPU_CHIPS")
+    if env is not None:
+        return int(env)
+    # TPU VM metadata conventions (ref for GPU analog: autodetect in node.py)
+    env = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    if env:
+        try:
+            dims = [int(x) for x in env.split(",")]
+            n = 1
+            for d in dims:
+                n *= d
+            return n
+        except ValueError:
+            pass
+    if os.environ.get("JAX_PLATFORMS", "").startswith(("tpu", "axon")):
+        return 1
+    return 0

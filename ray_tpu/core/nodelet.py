@@ -1,0 +1,520 @@
+"""Per-node daemon ("nodelet").
+
+Reference: src/ray/raylet/ — NodeManager (node_manager.h:119) owns the worker
+pool, grants worker leases, manages local resources and placement-group
+bundles, and embeds the object plane. Re-designs for TPU hosts:
+
+- Resources are {CPU, TPU(chips), memory, custom...}; the TPU quantity is the
+  host's local chip count, and slice/ICI topology labels ride on the
+  NodeInfo record so the control plane can gang-schedule whole slices.
+- The node object store is the native shm segment (ray_tpu/native); the
+  nodelet creates it and hands its name to every worker it spawns.
+- Object transfer between nodes is chunked pull over the RPC layer
+  (ref: ObjectManager::Push/HandlePush object_manager.cc:338,561 and
+  PullManager pull_manager.h:52): the requesting nodelet streams chunks from
+  the holder into a create/seal buffer.
+
+Lease protocol (ref: node_manager.cc:1881 HandleRequestWorkerLease →
+cluster_task_manager.h:42 queue/dispatch/spillback):
+  owner → rpc_request_lease(resources, ...) →
+    granted {worker_addr, lease_id} | spillback {addr} | queued until free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.common import Address, NodeInfo, ResourceSet, TaskSpec
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID
+from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.core.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
+
+logger = logging.getLogger("ray_tpu.nodelet")
+
+
+class WorkerRecord:
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.addr: Optional[Address] = None
+        self.state = "starting"        # starting | idle | leased | actor | dead
+        self.lease_id: Optional[bytes] = None
+        self.job_id: Optional[bytes] = None
+        self.last_idle = time.time()
+        self.ready = asyncio.Event()
+
+
+class _PendingLease:
+    def __init__(self, resources: ResourceSet, pg, fut):
+        self.resources = resources
+        self.pg = pg                   # (pg_id, bundle_index) or None
+        self.fut: asyncio.Future = fut
+
+
+class Nodelet:
+    def __init__(self, cfg: Config, gcs_addr: Address, session_dir: str,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, Any]] = None,
+                 store_name: Optional[str] = None):
+        self.cfg = cfg
+        self.gcs_addr = gcs_addr
+        self.session_dir = session_dir
+        self.node_id = NodeID.from_random()
+        self.store_name = store_name or f"/raytpu_{self.node_id.hex()[:12]}"
+        res = dict(resources) if resources else {}
+        res.setdefault("CPU", float(os.cpu_count() or 1))
+        self.total = ResourceSet(res)
+        self.available = self.total.copy()
+        self.labels = labels or {}
+        self.workers: Dict[bytes, WorkerRecord] = {}
+        self.leases: Dict[bytes, WorkerRecord] = {}
+        self.lease_resources: Dict[bytes, Tuple[ResourceSet, Optional[Tuple]]] = {}
+        self.pending: deque[_PendingLease] = deque()
+        # pg_id -> {bundle_index -> {"resources", "available", "committed"}}
+        self.pg_bundles: Dict[PlacementGroupID, Dict[int, dict]] = {}
+        self.pool = ClientPool()
+        self.server = RpcServer(self)
+        self.store: Optional[SharedMemoryStore] = None
+        self._hb_seq = 0
+        self._stopping = False
+
+    # ------------------------------------------------------------------- boot
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        self.store = SharedMemoryStore(
+            self.store_name, capacity=self.cfg.object_store_memory,
+            max_objects=self.cfg.object_store_max_objects, create=True)
+        self.server.host, self.server.port = host, port
+        addr = await self.server.start()
+        info = NodeInfo(node_id=self.node_id, nodelet_addr=addr,
+                        resources_total=self.total, labels=self.labels,
+                        store_name=self.store_name)
+        gcs = self.pool.get(self.gcs_addr)
+        r = await gcs.call("register_node", info=info,
+                           timeout=self.cfg.rpc_connect_timeout_s)
+        assert r["ok"]
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._heartbeat_loop())
+        loop.create_task(self._reap_loop())
+        for _ in range(self.cfg.worker_pool_prestart):
+            loop.create_task(self._start_worker())
+        return addr
+
+    async def _heartbeat_loop(self):
+        period = self.cfg.health_check_period_s / 2
+        gcs = self.pool.get(self.gcs_addr)
+        while not self._stopping:
+            self._hb_seq += 1
+            try:
+                await gcs.call("heartbeat", node_id=self.node_id, seqno=self._hb_seq,
+                               available=self.available, timeout=5.0)
+            except (ConnectionLost, RemoteError, OSError):
+                pass
+            await asyncio.sleep(period)
+
+    async def _reap_loop(self):
+        """Detect worker deaths; free leases; report to GCS
+        (ref: NodeManager worker failure path / HandleUnexpectedWorkerFailure)."""
+        gcs = self.pool.get(self.gcs_addr)
+        while not self._stopping:
+            await asyncio.sleep(0.1)
+            now = time.time()
+            for w in list(self.workers.values()):
+                if w.state == "dead":
+                    continue
+                rc = w.proc.poll()
+                if rc is not None:
+                    was = w.state
+                    self._on_worker_dead(w)
+                    if was in ("leased", "actor"):
+                        try:
+                            await gcs.call("report_worker_death", worker_id=w.worker_id,
+                                           node_id=self.node_id,
+                                           reason=f"exit code {rc}")
+                        except Exception:
+                            pass
+                elif (w.state == "idle"
+                      and now - w.last_idle > self.cfg.worker_idle_timeout_s
+                      and len(self.workers) > self.cfg.worker_pool_prestart):
+                    self._kill_worker(w, "idle timeout")
+
+    def _on_worker_dead(self, w: WorkerRecord):
+        w.state = "dead"
+        self.workers.pop(w.worker_id, None)
+        if w.lease_id is not None:
+            self._release_lease(w.lease_id)
+
+    # ---------------------------------------------------------------- workers
+
+    async def _start_worker(self) -> Optional[WorkerRecord]:
+        worker_id = os.urandom(20)
+        log_base = os.path.join(self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}")
+        os.makedirs(os.path.dirname(log_base), exist_ok=True)
+        out = open(log_base + ".out", "ab")
+        err = open(log_base + ".err", "ab")
+        env = dict(os.environ)
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        cmd = [sys.executable, "-m", "ray_tpu.core.worker",
+               "--nodelet", f"{self.server.host}:{self.server.port}",
+               "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
+               "--store", self.store_name,
+               "--node-id", self.node_id.hex(),
+               "--worker-id", worker_id.hex(),
+               "--config", self.cfg.to_json()]
+        proc = subprocess.Popen(cmd, stdout=out, stderr=err, env=env,
+                                start_new_session=True)
+        out.close(); err.close()
+        w = WorkerRecord(worker_id, proc)
+        self.workers[worker_id] = w
+        try:
+            await asyncio.wait_for(w.ready.wait(), self.cfg.worker_start_timeout_s)
+        except asyncio.TimeoutError:
+            self._kill_worker(w, "startup timeout")
+            return None
+        return w
+
+    def _kill_worker(self, w: WorkerRecord, reason: str):
+        logger.info("killing worker %s: %s", w.worker_id.hex()[:8], reason)
+        try:
+            w.proc.terminate()
+        except Exception:
+            pass
+        self._on_worker_dead(w)
+
+    async def rpc_register_worker(self, worker_id: bytes, addr: Address) -> dict:
+        w = self.workers.get(worker_id)
+        if w is None:
+            return {"ok": False}
+        w.addr = tuple(addr)
+        w.state = "idle"
+        w.last_idle = time.time()
+        w.ready.set()
+        return {"ok": True}
+
+    async def rpc_kill_worker(self, worker_id: bytes, reason: str = "") -> dict:
+        w = self.workers.get(worker_id)
+        if w is not None:
+            self._kill_worker(w, reason or "requested")
+        return {"ok": True}
+
+    async def _pop_worker(self) -> Optional[WorkerRecord]:
+        for w in self.workers.values():
+            if w.state == "idle":
+                return w
+        if len(self.workers) < self.cfg.max_workers_per_node:
+            return await self._start_worker()
+        # Pool saturated: wait for an idle worker.
+        deadline = time.time() + self.cfg.worker_lease_timeout_s
+        while time.time() < deadline:
+            await asyncio.sleep(0.02)
+            for w in self.workers.values():
+                if w.state == "idle":
+                    return w
+        return None
+
+    # ----------------------------------------------------------------- leases
+
+    def _resource_pool(self, pg: Optional[Tuple]) -> Optional[ResourceSet]:
+        """The pool a lease draws from: node-available or a committed bundle."""
+        if pg is None:
+            return self.available
+        pg_id, bundle_index = pg
+        bundles = self.pg_bundles.get(pg_id)
+        if not bundles:
+            return None
+        if bundle_index >= 0:
+            b = bundles.get(bundle_index)
+            return b["available"] if b and b["committed"] else None
+        for b in bundles.values():
+            if b["committed"]:
+                return b["available"]
+        return None
+
+    async def rpc_request_lease(self, resources: ResourceSet,
+                                pg: Optional[Tuple] = None,
+                                grant_or_reject: bool = False) -> dict:
+        pool = self._resource_pool(pg)
+        if pool is None:
+            return {"status": "infeasible", "error": "placement group bundle not here"}
+        if pg is None and not resources.fits_in(self.total):
+            # Permanently infeasible on this node → spillback advice
+            # (ref: cluster_task_manager.cc infeasible queue + spillback reply).
+            target = await self._ask_spillback(resources)
+            if target is not None and target["node_id"] != self.node_id:
+                return {"status": "spillback", "addr": target["addr"],
+                        "node_id": target["node_id"]}
+            return {"status": "infeasible",
+                    "error": f"no node can satisfy {resources.quantities}"}
+        if resources.fits_in(pool):
+            return await self._grant(resources, pg)
+        if grant_or_reject:
+            return {"status": "rejected"}
+        # Feasible but busy → try spillback to an idle peer, else queue here
+        # (ref: hybrid policy prefers local until spread threshold).
+        if pg is None:
+            target = await self._ask_spillback(resources)
+            if target is not None and target["node_id"] != self.node_id:
+                return {"status": "spillback", "addr": target["addr"],
+                        "node_id": target["node_id"]}
+        fut = asyncio.get_running_loop().create_future()
+        self.pending.append(_PendingLease(resources, pg, fut))
+        try:
+            return await asyncio.wait_for(fut, self.cfg.worker_lease_timeout_s)
+        except asyncio.TimeoutError:
+            return {"status": "retry"}
+
+    async def _ask_spillback(self, resources: ResourceSet) -> Optional[dict]:
+        gcs = self.pool.get(self.gcs_addr)
+        try:
+            return await gcs.call("pick_node", resources=resources,
+                                  strategy_kind="DEFAULT", timeout=5.0)
+        except (ConnectionLost, RemoteError, OSError):
+            return None
+
+    async def _grant(self, resources: ResourceSet, pg: Optional[Tuple]) -> dict:
+        pool = self._resource_pool(pg)
+        pool.subtract(resources)
+        w = await self._pop_worker()
+        if w is None:
+            pool.add(resources)
+            return {"status": "retry", "error": "no worker available"}
+        lease_id = os.urandom(16)
+        w.state = "leased"
+        w.lease_id = lease_id
+        self.leases[lease_id] = w
+        self.lease_resources[lease_id] = (resources, pg)
+        return {"status": "granted", "lease_id": lease_id,
+                "worker_addr": w.addr, "worker_id": w.worker_id}
+
+    async def rpc_return_lease(self, lease_id: bytes) -> dict:
+        self._release_lease(lease_id)
+        return {"ok": True}
+
+    def _release_lease(self, lease_id: bytes):
+        w = self.leases.pop(lease_id, None)
+        entry = self.lease_resources.pop(lease_id, None)
+        if entry is not None:
+            resources, pg = entry
+            pool = self._resource_pool(pg)
+            if pool is not None:
+                pool.add(resources)
+        if w is not None and w.state == "leased":
+            w.state = "idle"
+            w.lease_id = None
+            w.last_idle = time.time()
+        self._drain_pending()
+
+    def _drain_pending(self):
+        if not self.pending:
+            return
+        loop = asyncio.get_running_loop()
+        still = deque()
+        while self.pending:
+            p = self.pending.popleft()
+            pool = self._resource_pool(p.pg)
+            if p.fut.done():
+                continue
+            if pool is not None and p.resources.fits_in(pool):
+                async def _do(p=p):
+                    r = await self._grant(p.resources, p.pg)
+                    if not p.fut.done():
+                        p.fut.set_result(r)
+                loop.create_task(_do())
+            else:
+                still.append(p)
+        self.pending = still
+
+    # ----------------------------------------------------------------- actors
+
+    async def rpc_create_actor(self, spec: TaskSpec) -> dict:
+        """Lease a dedicated worker and run the creation task on it
+        (ref: gcs_actor_scheduler leases from raylet + pushes creation)."""
+        pg = None
+        if spec.scheduling.kind == "PLACEMENT_GROUP":
+            pg = (spec.scheduling.pg_id, spec.scheduling.bundle_index)
+        r = await self.rpc_request_lease(resources=spec.resources, pg=pg)
+        if r["status"] != "granted":
+            return {"ok": False, "retryable": r["status"] in ("retry", "spillback"),
+                    "error": r.get("error", r["status"])}
+        w = self.leases[r["lease_id"]]
+        w.state = "actor"
+        w.job_id = spec.job_id.binary()
+        client = self.pool.get(tuple(w.addr))
+        try:
+            res = await client.call("create_actor", spec=spec,
+                                    timeout=self.cfg.worker_start_timeout_s)
+        except (ConnectionLost, RemoteError, OSError) as e:
+            self._kill_worker(w, f"actor creation rpc failed: {e}")
+            return {"ok": False, "retryable": True, "error": str(e)}
+        if not res.get("ok"):
+            self._kill_worker(w, "actor __init__ failed")
+            return {"ok": False, "retryable": False, "error": res.get("error")}
+        return {"ok": True, "worker_addr": w.addr, "worker_id": w.worker_id}
+
+    # ------------------------------------------------------- placement groups
+
+    async def rpc_pg_prepare(self, pg_id: PlacementGroupID, bundle_index: int,
+                             resources: ResourceSet) -> dict:
+        if not resources.fits_in(self.available):
+            return {"ok": False}
+        self.available.subtract(resources)
+        self.pg_bundles.setdefault(pg_id, {})[bundle_index] = {
+            "resources": resources.copy(), "available": resources.copy(),
+            "committed": False}
+        return {"ok": True}
+
+    async def rpc_pg_commit(self, pg_id: PlacementGroupID, bundle_index: int) -> dict:
+        b = self.pg_bundles.get(pg_id, {}).get(bundle_index)
+        if b is None:
+            return {"ok": False}
+        b["committed"] = True
+        self._drain_pending()
+        return {"ok": True}
+
+    async def rpc_pg_return(self, pg_id: PlacementGroupID, bundle_index: int) -> dict:
+        b = self.pg_bundles.get(pg_id, {}).pop(bundle_index, None)
+        if b is not None:
+            self.available.add(b["resources"])
+            self._drain_pending()
+        return {"ok": True}
+
+    # ----------------------------------------------------------- object plane
+
+    async def rpc_has_object(self, oid: ObjectID) -> bool:
+        return self.store.contains(oid)
+
+    async def rpc_read_chunk(self, oid: ObjectID, offset: int, size: int) -> Optional[dict]:
+        """Serve one chunk of a local sealed object (ref: HandlePush chunks)."""
+        view = self.store.get_view(oid)
+        if view is None:
+            return None
+        try:
+            total = len(view)
+            data = bytes(view[offset:offset + size])
+        finally:
+            del view
+            self.store.release(oid)
+        return {"total": total, "data": data}
+
+    async def rpc_pull_object(self, oid: ObjectID, source: Address) -> dict:
+        """Pull a remote object into the local store, chunked
+        (ref: PullManager pull_manager.h:52 + ObjectManager::Push)."""
+        if self.store.contains(oid):
+            return {"ok": True}
+        src = self.pool.get(tuple(source))
+        chunk = self.cfg.object_transfer_chunk_bytes
+        try:
+            first = await src.call("read_chunk", oid=oid, offset=0, size=chunk)
+        except (ConnectionLost, RemoteError, OSError) as e:
+            return {"ok": False, "error": f"source unreachable: {e}"}
+        if first is None:
+            return {"ok": False, "error": "object not at source"}
+        total = first["total"]
+        view = self.store.create_view(oid, total)
+        if view is None:
+            if self.store.contains(oid):
+                return {"ok": True}
+            return {"ok": False, "error": "local store full"}
+        try:
+            data = first["data"]
+            view[0:len(data)] = data
+            off = len(data)
+            while off < total:
+                r = await src.call("read_chunk", oid=oid, offset=off, size=chunk)
+                if r is None:
+                    raise ConnectionLost("object vanished at source mid-pull")
+                view[off:off + len(r["data"])] = r["data"]
+                off += len(r["data"])
+        except Exception as e:
+            del view
+            self.store.abort(oid)
+            return {"ok": False, "error": str(e)}
+        del view
+        self.store.seal(oid)
+        return {"ok": True}
+
+    async def rpc_delete_objects(self, oids: List[ObjectID]) -> dict:
+        for oid in oids:
+            self.store.delete(oid)
+        return {"ok": True}
+
+    # ------------------------------------------------------------------- misc
+
+    async def rpc_job_finished(self, job_id: bytes) -> dict:
+        for w in list(self.workers.values()):
+            if w.job_id == job_id:
+                self._kill_worker(w, "job finished")
+        return {"ok": True}
+
+    async def rpc_node_stats(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "workers": {w.worker_id.hex()[:8]: w.state for w in self.workers.values()},
+            "available": self.available.quantities,
+            "total": self.total.quantities,
+            "store_bytes": self.store.bytes_in_use(),
+            "store_objects": self.store.num_objects(),
+            "store_evictions": self.store.num_evictions(),
+            "pending_leases": len(self.pending),
+        }
+
+    async def rpc_ping(self) -> dict:
+        return {"ok": True}
+
+    async def rpc_shutdown(self) -> dict:
+        self._stopping = True
+        for w in list(self.workers.values()):
+            self._kill_worker(w, "nodelet shutdown")
+        if self.store is not None:
+            self.store.close(destroy=True)
+        asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))
+        return {"ok": True}
+
+
+def main():
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--config", default="{}")
+    parser.add_argument("--ready-fd", type=int, default=-1)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[nodelet] %(asctime)s %(levelname)s %(message)s")
+    cfg = Config.from_json(args.config)
+    gh, gp = args.gcs.rsplit(":", 1)
+
+    async def run():
+        nodelet = Nodelet(cfg, (gh, int(gp)), args.session_dir,
+                          resources=json.loads(args.resources),
+                          labels=json.loads(args.labels))
+        host, port = await nodelet.start(args.host, args.port)
+        if args.ready_fd >= 0:
+            os.write(args.ready_fd,
+                     f"{host}:{port}:{nodelet.node_id.hex()}:{nodelet.store_name}\n".encode())
+            os.close(args.ready_fd)
+        logger.info("nodelet %s on %s:%d", nodelet.node_id.hex()[:8], host, port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,247 @@
+"""Node object plane: native shm store binding + in-process memory store.
+
+Two tiers, mirroring the reference's two store providers
+(src/ray/core_worker/store_provider/):
+
+- `SharedMemoryStore` — ctypes binding over the native C++ segment
+  (ray_tpu/native/objstore.cc; plasma-equivalent). All processes on a node
+  attach to one segment named after the session; puts/gets are zero-copy
+  in shared memory.
+- `MemoryStore` — per-process dict of small/direct-return objects with
+  asyncio-friendly waiters (ref: CoreWorkerMemoryStore,
+  store_provider/memory_store/).
+
+The HBM tier (device-resident jax.Array values) is deliberately per-process:
+XLA owns device allocations, so cross-process object exchange always goes
+through host bytes; `ray_tpu.util.device.device_put_ref` offers the
+device-placement fast path on the consuming side.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.status import ObjectStoreFullError
+
+
+class _Lib:
+    _lib = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._lib is None:
+                from ray_tpu.native import ensure_built
+
+                lib = ctypes.CDLL(ensure_built())
+                lib.ts_create.restype = ctypes.c_void_p
+                lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+                lib.ts_attach.restype = ctypes.c_void_p
+                lib.ts_attach.argtypes = [ctypes.c_char_p]
+                lib.ts_detach.argtypes = [ctypes.c_void_p]
+                lib.ts_destroy.argtypes = [ctypes.c_char_p]
+                lib.ts_total_size.restype = ctypes.c_uint64
+                lib.ts_total_size.argtypes = [ctypes.c_void_p]
+                lib.ts_create_buf.restype = ctypes.c_uint64
+                lib.ts_create_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+                lib.ts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.ts_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.ts_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64]
+                lib.ts_get.restype = ctypes.c_uint64
+                lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+                lib.ts_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.ts_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.ts_bytes_in_use.restype = ctypes.c_uint64
+                lib.ts_bytes_in_use.argtypes = [ctypes.c_void_p]
+                lib.ts_capacity.restype = ctypes.c_uint64
+                lib.ts_capacity.argtypes = [ctypes.c_void_p]
+                lib.ts_num_objects.restype = ctypes.c_uint32
+                lib.ts_num_objects.argtypes = [ctypes.c_void_p]
+                lib.ts_num_evictions.restype = ctypes.c_uint64
+                lib.ts_num_evictions.argtypes = [ctypes.c_void_p]
+                cls._lib = lib
+            return cls._lib
+
+
+class SharedMemoryStore:
+    """One per process; attaches to the node's shm segment."""
+
+    def __init__(self, name: str, capacity: int = 0, max_objects: int = 1 << 15,
+                 create: bool = False):
+        self._lib = _Lib.get()
+        self.name = name
+        cname = name.encode()
+        if create:
+            self._h = self._lib.ts_create(cname, capacity, max_objects)
+        else:
+            self._h = self._lib.ts_attach(cname)
+        if not self._h:
+            raise RuntimeError(f"object store {'create' if create else 'attach'} failed: {name}")
+        total = self._lib.ts_total_size(self._h)
+        # Map the same segment in Python for zero-copy views.
+        fd = os.open(f"/dev/shm{name}", os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mm)
+        self._created = create
+
+    # -- raw byte API --------------------------------------------------------
+
+    def put_bytes(self, oid: ObjectID, data: bytes) -> bool:
+        rc = self._lib.ts_put(self._h, oid.binary(), data, len(data))
+        if rc == -2:
+            raise ObjectStoreFullError(
+                f"object of {len(data)} bytes does not fit (store {self.name})")
+        return rc == 0  # False => already present (idempotent put)
+
+    def create_view(self, oid: ObjectID, size: int) -> Optional[memoryview]:
+        off = self._lib.ts_create_buf(self._h, oid.binary(), size)
+        if off == 0:
+            return None
+        return self._view[off:off + size]
+
+    def seal(self, oid: ObjectID) -> None:
+        self._lib.ts_seal(self._h, oid.binary())
+
+    def abort(self, oid: ObjectID) -> None:
+        self._lib.ts_abort(self._h, oid.binary())
+
+    def get_view(self, oid: ObjectID) -> Optional[memoryview]:
+        """Pins the object; caller must release(oid) when the view is dropped."""
+        size = ctypes.c_uint64()
+        off = self._lib.ts_get(self._h, oid.binary(), ctypes.byref(size))
+        if off == 0:
+            return None
+        return self._view[off:off + size.value]
+
+    def release(self, oid: ObjectID) -> None:
+        self._lib.ts_release(self._h, oid.binary())
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.ts_contains(self._h, oid.binary()))
+
+    def delete(self, oid: ObjectID) -> None:
+        self._lib.ts_delete(self._h, oid.binary())
+
+    # -- object API ----------------------------------------------------------
+
+    def put(self, oid: ObjectID, value: Any) -> bool:
+        """Serialize straight into the store (single copy for oob buffers)."""
+        meta, bufs = serialization.serialize(value)
+        size = serialization.serialized_size(meta, bufs)
+        view = self.create_view(oid, size)
+        if view is None:
+            if self.contains(oid):
+                return False
+            raise ObjectStoreFullError(
+                f"object of {size} bytes does not fit (store {self.name})")
+        try:
+            serialization.write_to(view, meta, bufs)
+        except BaseException:
+            self.abort(oid)
+            raise
+        finally:
+            del view
+        self.seal(oid)
+        return True
+
+    def get(self, oid: ObjectID, *, copy: bool = True) -> Any:
+        """Deserialize. copy=False returns buffers aliasing shm (caller keeps
+        the pin until it drops the value — we release immediately after
+        materializing when copy=True)."""
+        view = self.get_view(oid)
+        if view is None:
+            raise KeyError(oid)
+        try:
+            if copy:
+                data = bytes(view)
+                return serialization.unpack(data)
+            return serialization.read_from(view)
+        finally:
+            if copy:
+                del view
+                self.release(oid)
+
+    # -- stats ---------------------------------------------------------------
+
+    def bytes_in_use(self) -> int:
+        return self._lib.ts_bytes_in_use(self._h)
+
+    def capacity(self) -> int:
+        return self._lib.ts_capacity(self._h)
+
+    def num_objects(self) -> int:
+        return self._lib.ts_num_objects(self._h)
+
+    def num_evictions(self) -> int:
+        return self._lib.ts_num_evictions(self._h)
+
+    def close(self, destroy: bool = False) -> None:
+        if self._h:
+            try:
+                self._view.release()
+                self._mm.close()
+            except BufferError:
+                pass  # outstanding zero-copy views; leak the map, not the shm
+            self._lib.ts_detach(self._h)
+            self._h = None
+        if destroy:
+            _Lib.get().ts_destroy(self.name.encode())
+
+
+class MemoryStore:
+    """In-process store for small/direct-return objects.
+
+    Thread-safe; get() blocks on a per-object event until the value arrives
+    (the reference's GetAsync callback chain, memory_store.cc).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[ObjectID, Any] = {}
+        self._events: Dict[ObjectID, threading.Event] = {}
+
+    def put(self, oid: ObjectID, value: Any) -> None:
+        with self._lock:
+            self._objects[oid] = value
+            ev = self._events.pop(oid, None)
+        if ev:
+            ev.set()
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._objects
+
+    def get_if_exists(self, oid: ObjectID):
+        with self._lock:
+            return self._objects.get(oid, _MISSING)
+
+    def wait_for(self, oid: ObjectID, timeout: Optional[float]) -> bool:
+        with self._lock:
+            if oid in self._objects:
+                return True
+            ev = self._events.get(oid)
+            if ev is None:
+                ev = self._events[oid] = threading.Event()
+        return ev.wait(timeout)
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._objects.pop(oid, None)
+
+    def keys(self) -> List[ObjectID]:
+        with self._lock:
+            return list(self._objects.keys())
+
+
+_MISSING = object()

@@ -1,0 +1,57 @@
+"""@ray_tpu.remote for functions.
+
+Reference: python/ray/remote_function.py:245 (RemoteFunction._remote → core
+worker submit at :391) and option resolution in _private/ray_option_utils.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.core.common import ResourceSet, SchedulingStrategy
+from ray_tpu.core import runtime as rt
+
+
+_TASK_OPTIONS = {
+    "num_cpus", "num_tpus", "memory", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "scheduling_strategy", "name",
+}
+
+
+class RemoteFunction:
+    def __init__(self, fn: Callable, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(options or {})
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        bad = set(opts) - _TASK_OPTIONS
+        if bad:
+            raise ValueError(f"invalid task options: {sorted(bad)}")
+        merged = dict(self._options)
+        merged.update(opts)
+        return RemoteFunction(self._fn, merged)
+
+    def remote(self, *args, **kwargs):
+        o = self._options
+        runtime = rt.get_runtime()
+        resources = ResourceSet.from_options(
+            o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
+            o.get("resources"))
+        refs = runtime.submit_task(
+            self._fn, args, kwargs,
+            name=o.get("name") or getattr(self._fn, "__name__", "task"),
+            num_returns=o.get("num_returns", 1),
+            resources=resources,
+            max_retries=o.get("max_retries"),
+            retry_exceptions=o.get("retry_exceptions", False),
+            scheduling=o.get("scheduling_strategy") or SchedulingStrategy())
+        if o.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{getattr(self._fn, '__name__', '?')}' cannot be "
+            "called directly; use .remote().")

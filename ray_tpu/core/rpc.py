@@ -1,0 +1,258 @@
+"""Asyncio message layer used by all ray_tpu daemons and workers.
+
+Reference: src/ray/rpc/ (GrpcServer / ClientCallManager). The reference wraps
+gRPC; here the control plane is a compact asyncio TCP protocol with
+length-prefixed pickled frames. The wire layer is isolated behind
+`RpcServer`/`RpcClient` so it can be swapped for gRPC (grpcio is available)
+without touching callers; for the target deployment shape — one daemon pair
+per TPU VM host, tens of hosts — connection counts are small and the pickle
+frame path is faster than protobuf ser/des for numpy-bearing payloads.
+
+Frames:  [u32 len][pickle((kind, msg_id, method, payload))]
+  kind: 0 = request, 1 = response-ok, 2 = response-error, 3 = one-way
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct("<I")
+REQUEST, RESPONSE_OK, RESPONSE_ERR, ONEWAY = 0, 1, 2, 3
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class RemoteError(RpcError):
+    """Handler raised on the other side; message carries remote traceback."""
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    data = await reader.readexactly(n)
+    return pickle.loads(data)
+
+
+def _frame(msg) -> bytes:
+    data = pickle.dumps(msg, protocol=5)
+    return _LEN.pack(len(data)) + data
+
+
+class RpcServer:
+    """Serves methods of a handler object. Any coroutine or plain method named
+    ``rpc_<method>`` is callable remotely with a single dict payload."""
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                try:
+                    kind, msg_id, method, payload = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                asyncio.get_running_loop().create_task(
+                    self._dispatch(writer, kind, msg_id, method, payload))
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, kind, msg_id, method, payload):
+        try:
+            fn = getattr(self.handler, f"rpc_{method}", None)
+            if fn is None:
+                raise RpcError(f"no such method: {method}")
+            res = fn(**payload)
+            if asyncio.iscoroutine(res):
+                res = await res
+            if kind == REQUEST:
+                writer.write(_frame((RESPONSE_OK, msg_id, method, res)))
+                await writer.drain()
+        except Exception:
+            if kind == REQUEST:
+                try:
+                    writer.write(_frame(
+                        (RESPONSE_ERR, msg_id, method, traceback.format_exc())))
+                    await writer.drain()
+                except Exception:
+                    pass
+
+
+class RpcClient:
+    """One connection to one server; safe for concurrent calls from one loop."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._ids = itertools.count()
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._read_task: Optional[asyncio.Task] = None
+
+    async def _ensure(self):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+            self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self):
+        try:
+            while True:
+                kind, msg_id, method, payload = await _read_frame(self._reader)
+                fut = self._pending.pop(msg_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == RESPONSE_OK:
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(RemoteError(f"{method} failed remotely:\n{payload}"))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            err = ConnectionLost(f"connection to {self.host}:{self.port} lost")
+            for fut in self._pending.values():
+                try:
+                    if not fut.done():
+                        fut.set_exception(err)
+                except RuntimeError:
+                    pass  # loop already closed during shutdown
+            self._pending.clear()
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+            self._writer = None
+
+    async def call(self, method: str, timeout: Optional[float] = None, **payload) -> Any:
+        fut = await self.start_call(method, **payload)
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def start_call(self, method: str, **payload) -> asyncio.Future:
+        """Write the request frame now; return the pending future.
+
+        The frame is on the wire (FIFO per connection) when this returns, so
+        callers that need ordered delivery (actor submit queues) serialize by
+        awaiting start_call before issuing the next one."""
+        await self._ensure()
+        msg_id = next(self._ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        self._writer.write(_frame((REQUEST, msg_id, method, payload)))
+        await self._writer.drain()
+        return fut
+
+    async def oneway(self, method: str, **payload) -> None:
+        await self._ensure()
+        self._writer.write(_frame((ONEWAY, next(self._ids), method, payload)))
+        await self._writer.drain()
+
+    async def close(self):
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        if self._read_task:
+            self._read_task.cancel()
+
+
+class ClientPool:
+    """Caches RpcClients by address (ref: rpc::ClientCallManager pooling)."""
+
+    def __init__(self):
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+
+    def get(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = tuple(addr)
+        c = self._clients.get(addr)
+        if c is None:
+            c = self._clients[addr] = RpcClient(*addr)
+        return c
+
+    def drop(self, addr: Tuple[str, int]) -> None:
+        self._clients.pop(tuple(addr), None)
+
+    async def close_all(self):
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread.
+
+    Drivers and workers embed their networked runtime this way (the reference
+    embeds an io_service thread inside CoreWorker). Synchronous public API
+    calls bridge in via `run()`.
+    """
+
+    def __init__(self, name: str = "ray_tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._main, name=name, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait()
+
+    def _main(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        """Run coroutine on the loop from another thread; blocks for result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        """Fire-and-forget from any thread."""
+        def _create():
+            self.loop.create_task(coro)
+        self.loop.call_soon_threadsafe(_create)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=2)

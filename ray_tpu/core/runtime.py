@@ -1,0 +1,990 @@
+"""In-process runtime: the core-worker equivalent embedded in every driver and
+worker process.
+
+Reference: src/ray/core_worker/core_worker.cc — one object that does task
+submission (SubmitTask :1855, CreateActor :1922, SubmitActorTask :2156),
+object management (Put :1119 / Get :1331 over memory-store + plasma
+providers), ownership (ReferenceCounter reference_count.h:59, TaskManager
+task_manager.h:173 with retries :234 and lineage), and serves the ownership
+protocol over its own RPC server (every worker is also a server).
+
+Differences from the reference, deliberate:
+- The submission path keeps the lease-reuse/pipelining idea
+  (direct_task_transport.cc:24,346,588) with one queue + leased-worker set
+  per scheduling class.
+- Borrower registration is borrower-initiated (see refcount.py).
+- The "plasma" tier is the node shm segment (native/objstore.cc); gets pin
+  the object for zero-copy numpy views, released when the local ref dies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import threading
+import time
+import traceback
+from collections import defaultdict, deque
+from concurrent.futures import Future as SyncFuture
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import (Address, ObjectRef, ResourceSet, RuntimeAddress,
+                                 SchedulingStrategy, TaskResult, TaskSpec)
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import MemoryStore, SharedMemoryStore, _MISSING
+from ray_tpu.core.refcount import ReferenceCounter
+from ray_tpu.core.rpc import (ClientPool, ConnectionLost, EventLoopThread,
+                              RemoteError, RpcServer)
+from ray_tpu.core.status import (ActorDiedError, ActorUnavailableError,
+                                 GetTimeoutError, ObjectLostError, TaskError,
+                                 WorkerCrashedError)
+
+logger = logging.getLogger("ray_tpu.runtime")
+
+_runtime_lock = threading.Lock()
+_global_runtime: Optional["Runtime"] = None
+
+
+def get_runtime() -> "Runtime":
+    if _global_runtime is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_runtime
+
+
+def current_runtime_or_none() -> Optional["Runtime"]:
+    return _global_runtime
+
+
+def set_runtime(rt: Optional["Runtime"]):
+    global _global_runtime
+    with _runtime_lock:
+        _global_runtime = rt
+
+
+class _ObjectEntry:
+    """Owner-side directory entry (ref: ObjectDirectory + memory store)."""
+
+    __slots__ = ("state", "inline", "locations", "error", "event", "spec")
+
+    def __init__(self):
+        self.state = "pending"        # pending | ready | error | lost
+        self.inline: Optional[bytes] = None
+        self.locations: Set[Address] = set()
+        self.error = None             # SerializedException
+        self.event = threading.Event()
+        self.spec: Optional[TaskSpec] = None   # lineage for reconstruction
+
+
+class _LeasedWorker:
+    def __init__(self, lease_id: bytes, worker_addr: Address, nodelet_addr: Address,
+                 worker_id: bytes):
+        self.lease_id = lease_id
+        self.worker_addr = tuple(worker_addr)
+        self.nodelet_addr = tuple(nodelet_addr)
+        self.worker_id = worker_id
+
+
+class _PendingTask:
+    def __init__(self, spec: TaskSpec, retries_left: int):
+        self.spec = spec
+        self.retries_left = retries_left
+
+
+class Runtime:
+    """One per process. mode: "driver" | "worker"."""
+
+    def __init__(self, cfg: Config, gcs_addr: Address, nodelet_addr: Address,
+                 store_name: str, job_id: JobID, mode: str = "driver",
+                 loop: Optional[asyncio.AbstractEventLoop] = None,
+                 worker_id: Optional[bytes] = None):
+        self.cfg = cfg
+        self.mode = mode
+        self.job_id = job_id
+        self.worker_id = worker_id or WorkerID.from_random().binary()
+        self.gcs_addr = tuple(gcs_addr)
+        self.nodelet_addr = tuple(nodelet_addr)
+        self.store_name = store_name
+
+        if loop is None:
+            self.loop_thread: Optional[EventLoopThread] = EventLoopThread()
+            self.loop = self.loop_thread.loop
+        else:
+            self.loop_thread = None
+            self.loop = loop
+
+        self.pool = ClientPool()
+        self.server = RpcServer(self)
+        self.memory_store = MemoryStore()
+        self.store = SharedMemoryStore(store_name)
+        self.refs = ReferenceCounter(self._self_addr, self._free_object,
+                                     self._notify_owner)
+        self.directory: Dict[ObjectID, _ObjectEntry] = {}
+        self._dir_lock = threading.Lock()
+        self._pinned: Dict[ObjectID, memoryview] = {}
+
+        # submission state, per scheduling class
+        self._queues: Dict[Tuple, deque] = defaultdict(deque)
+        self._class_leases: Dict[Tuple, List[_LeasedWorker]] = defaultdict(list)
+        self._class_pending_lease: Dict[Tuple, int] = defaultdict(int)
+        self._inflight: Dict[TaskID, _PendingTask] = {}
+
+        # actor client state
+        self._actor_addr: Dict[ActorID, Optional[Address]] = {}
+        self._actor_state: Dict[ActorID, dict] = {}
+        self._actor_seq: Dict[ActorID, int] = defaultdict(int)
+        self._actor_events: Dict[ActorID, threading.Event] = {}
+        self._actor_queues: Dict[ActorID, deque] = {}
+        self._actor_sending: Dict[ActorID, bool] = {}
+
+        # execution context (worker mode): thread-local so concurrent actor
+        # threads get distinct put-id spaces (ref: TaskID-scoped put indices)
+        self.current_task_id: TaskID = TaskID.for_driver(job_id)
+        self._exec_ctx = threading.local()
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._exported: Set[bytes] = set()
+        self._task_events: List[dict] = []
+        self.address: Optional[RuntimeAddress] = None
+        self._started = False
+        self._shutdown = False
+
+    # ------------------------------------------------------------------ boot
+
+    def start(self) -> RuntimeAddress:
+        host, port = self._run(self._start_server())
+        self.address = RuntimeAddress(host, port, self.worker_id)
+        self._started = True
+        return self.address
+
+    async def _start_server(self):
+        return await self.server.start()
+
+    def _run(self, coro, timeout: Optional[float] = None):
+        """Bridge a coroutine onto the runtime loop from any thread."""
+        try:
+            if asyncio.get_running_loop() is self.loop:
+                raise RuntimeError(
+                    "Runtime blocking call issued from the event-loop thread; "
+                    "this would deadlock — move the call to an executor thread")
+        except RuntimeError as e:
+            if "would deadlock" in str(e):
+                raise
+        if self.loop_thread is not None:
+            return self.loop_thread.run(coro, timeout)
+        # worker mode: called from executor threads, loop runs in main thread
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def _spawn(self, coro):
+        if self.loop_thread is not None:
+            self.loop_thread.spawn(coro)
+        else:
+            self.loop.call_soon_threadsafe(lambda: self.loop.create_task(coro))
+
+    def _self_addr(self) -> Optional[RuntimeAddress]:
+        return self.address
+
+    def shutdown(self):
+        self._shutdown = True
+        try:
+            self._run(self.server.stop(), timeout=2)
+        except Exception:
+            pass
+        if self.loop_thread:
+            self.loop_thread.stop()
+        try:
+            self.store.close()
+        except Exception:
+            pass
+        set_runtime(None)
+
+    # ------------------------------------------------------------ gcs helpers
+
+    def gcs_call(self, method: str, rpc_timeout: Optional[float] = 60.0, **kw):
+        """kw may itself contain a `timeout` destined for the handler;
+        `rpc_timeout` is the transport deadline."""
+        return self._run(
+            self.pool.get(self.gcs_addr).call(method, timeout=rpc_timeout, **kw))
+
+    def kv_put(self, ns: str, key: bytes, value: bytes, overwrite: bool = True) -> bool:
+        return self.gcs_call("kv_put", ns=ns, key=key, value=value, overwrite=overwrite)
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        return self.gcs_call("kv_get", ns=ns, key=key)
+
+    # ---------------------------------------------------------------- objects
+
+    def set_exec_context(self, task_id: TaskID):
+        self._exec_ctx.task_id = task_id
+        self._exec_ctx.put_index = 0
+
+    def clear_exec_context(self):
+        self._exec_ctx.task_id = None
+        self._exec_ctx.put_index = 0
+
+    def get_current_task_id(self) -> TaskID:
+        tid = getattr(self._exec_ctx, "task_id", None)
+        return tid if tid is not None else self.current_task_id
+
+    def _next_put_id(self) -> ObjectID:
+        tid = getattr(self._exec_ctx, "task_id", None)
+        if tid is not None:
+            self._exec_ctx.put_index += 1
+            return ObjectID.for_put(tid, self._exec_ctx.put_index)
+        with self._put_lock:
+            self._put_index += 1
+            return ObjectID.for_put(self.current_task_id, self._put_index)
+
+    def _entry(self, oid: ObjectID) -> _ObjectEntry:
+        with self._dir_lock:
+            e = self.directory.get(oid)
+            if e is None:
+                e = self.directory[oid] = _ObjectEntry()
+            return e
+
+    def put(self, value: Any, _pin: bool = True) -> ObjectRef:
+        """ref: CoreWorker::Put core_worker.cc:1119."""
+        oid = self._next_put_id()
+        meta, bufs = serialization.serialize(value)
+        size = serialization.serialized_size(meta, bufs)
+        e = self._entry(oid)
+        self.refs.register_owned(oid)
+        if size <= self.cfg.max_direct_call_object_size:
+            packed = bytearray(size)
+            serialization.write_to(memoryview(packed), meta, bufs)
+            e.inline = bytes(packed)
+            self.memory_store.put(oid, value)
+        else:
+            view = self.store.create_view(oid, size)
+            if view is None:
+                if not self.store.contains(oid):
+                    from ray_tpu.core.status import ObjectStoreFullError
+
+                    raise ObjectStoreFullError(f"cannot store {size} bytes")
+            else:
+                serialization.write_to(view, meta, bufs)
+                del view
+                self.store.seal(oid)
+            if _pin:
+                v = self.store.get_view(oid)   # pin primary copy
+                if v is not None:
+                    self._pinned[oid] = v
+            e.locations.add(self.nodelet_addr)
+        e.state = "ready"
+        e.event.set()
+        return ObjectRef(oid, self.address)
+
+    def _free_object(self, oid: ObjectID):
+        """All refs gone: drop every copy (ref: ReferenceCounter on-zero →
+        delete from plasma + local memory store; lineage released)."""
+        self.memory_store.delete(oid)
+        v = self._pinned.pop(oid, None)
+        if v is not None:
+            try:
+                del v
+            finally:
+                self.store.release(oid)
+        with self._dir_lock:
+            e = self.directory.pop(oid, None)
+        if e is not None and e.locations:
+            for addr in e.locations:
+                self._spawn(self._delete_remote(addr, [oid]))
+
+    async def _delete_remote(self, addr: Address, oids: List[ObjectID]):
+        try:
+            await self.pool.get(addr).call("delete_objects", oids=oids, timeout=5.0)
+        except Exception:
+            pass
+
+    def _notify_owner(self, owner: RuntimeAddress, op: str, oid: ObjectID):
+        async def _send():
+            try:
+                await self.pool.get(owner.addr).call(
+                    op, oid=oid, borrower_id=self.worker_id, timeout=5.0)
+            except Exception:
+                pass
+        self._spawn(_send())
+
+    # --- get ----------------------------------------------------------------
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        """ref: CoreWorker::Get core_worker.cc:1331."""
+        deadline = None if timeout is None else time.time() + timeout
+        return [self._get_one(r, deadline) for r in refs]
+
+    def _remaining(self, deadline: Optional[float]) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - time.time()
+        if rem <= 0:
+            raise GetTimeoutError("ray_tpu.get timed out")
+        return rem
+
+    def _get_one(self, ref: ObjectRef, deadline: Optional[float], _depth: int = 0) -> Any:
+        oid = ref.id
+        # 1. in-process memory store
+        v = self.memory_store.get_if_exists(oid)
+        if v is not _MISSING:
+            if isinstance(v, serialization.SerializedException):
+                raise v.to_exception()
+            return v
+        # 2. local shm store (pin + zero-copy)
+        if self.store.contains(oid):
+            val = self._read_local(oid)
+            if val is not _MISSING:
+                return val
+        if self.refs.is_owned(oid) or (self.address is not None
+                                       and ref.owner.worker_id == self.worker_id):
+            return self._get_owned(ref, deadline, _depth)
+        return self._get_borrowed(ref, deadline, _depth)
+
+    def _read_local(self, oid: ObjectID):
+        view = self.store.get_view(oid)
+        if view is None:
+            return _MISSING
+        if oid not in self._pinned:
+            self._pinned[oid] = view          # hold pin for zero-copy validity
+        else:
+            self.store.release(oid)           # already pinned once
+        value = serialization.read_from(self._pinned[oid])
+        if isinstance(value, serialization.SerializedException):
+            raise value.to_exception()
+        return value
+
+    def _get_owned(self, ref: ObjectRef, deadline: Optional[float], _depth: int) -> Any:
+        oid = ref.id
+        e = self._entry(oid)
+        while True:
+            rem = self._remaining(deadline)
+            if not e.event.wait(timeout=rem if rem is not None else 1.0):
+                if rem is not None:
+                    raise GetTimeoutError(f"object {oid.hex()[:12]} not ready in time")
+                continue
+            break
+        if e.state == "error":
+            raise e.error.to_exception()
+        if e.state == "lost":
+            return self._try_reconstruct(ref, deadline, _depth)
+        v = self.memory_store.get_if_exists(oid)
+        if v is not _MISSING:
+            if isinstance(v, serialization.SerializedException):
+                raise v.to_exception()
+            return v
+        if e.inline is not None:
+            return serialization.unpack(e.inline)
+        # value lives in some node store
+        val = self._fetch_from_locations(oid, list(e.locations))
+        if val is _MISSING:
+            return self._try_reconstruct(ref, deadline, _depth)
+        return val
+
+    def _get_borrowed(self, ref: ObjectRef, deadline: Optional[float], _depth: int) -> Any:
+        oid = ref.id
+        owner = ref.owner
+        while True:
+            rem = self._remaining(deadline)
+            step = min(rem, 5.0) if rem is not None else 5.0
+            try:
+                r = self._run(self.pool.get(owner.addr).call(
+                    "wait_object", oid=oid, wait_timeout=step, timeout=step + 10.0), timeout=step + 15.0)
+            except (ConnectionLost, RemoteError, OSError, TimeoutError) as err:
+                raise ObjectLostError(
+                    f"owner of {oid.hex()[:12]} unreachable: {err}") from None
+            status = r["status"]
+            if status == "pending":
+                continue
+            if status == "error":
+                raise r["error"].to_exception()
+            if status == "lost":
+                raise ObjectLostError(f"object {oid.hex()[:12]} lost at owner")
+            if r.get("inline") is not None:
+                return serialization.unpack(r["inline"])
+            val = self._fetch_from_locations(oid, [tuple(a) for a in r["locations"]])
+            if val is _MISSING:
+                raise ObjectLostError(
+                    f"object {oid.hex()[:12]} not reachable from any location")
+            return val
+
+    def _fetch_from_locations(self, oid: ObjectID, locations: List[Address]):
+        if self.store.contains(oid):
+            v = self._read_local(oid)
+            if v is not _MISSING:
+                return v
+        for loc in locations:
+            if tuple(loc) == self.nodelet_addr:
+                continue
+            try:
+                r = self._run(self.pool.get(self.nodelet_addr).call(
+                    "pull_object", oid=oid, source=tuple(loc), timeout=120.0))
+            except (ConnectionLost, RemoteError, OSError) as e:
+                logger.warning("pull of %s failed: %s", oid.hex()[:12], e)
+                continue
+            if r.get("ok"):
+                v = self._read_local(oid)
+                if v is not _MISSING:
+                    return v
+        # one more local attempt (producer may be co-located)
+        v = self._read_local(oid)
+        return v
+
+    def _try_reconstruct(self, ref: ObjectRef, deadline: Optional[float], _depth: int) -> Any:
+        """Lineage reconstruction (ref: object_recovery_manager.h — re-execute
+        the producing task)."""
+        oid = ref.id
+        e = self._entry(oid)
+        if e.spec is None or _depth > 10:
+            raise ObjectLostError(
+                f"object {oid.hex()[:12]} lost and not reconstructable")
+        logger.warning("reconstructing %s via lineage", oid.hex()[:12])
+        spec = e.spec
+        for rid in spec.return_ids():
+            re_ = self._entry(rid)
+            re_.state = "pending"
+            re_.inline = None
+            re_.locations = set()
+            re_.event.clear()
+            self.refs.register_owned(rid)
+        self._submit_spec(spec, retries_left=spec.max_retries)
+        return self._get_one(ref, deadline, _depth + 1)
+
+    # --- wait ---------------------------------------------------------------
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """ref: worker.py:2582 / CoreWorker::Wait."""
+        deadline = None if timeout is None else time.time() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while len(ready) < num_returns:
+            still = []
+            for r in pending:
+                if self._is_ready(r):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.id
+        if self.memory_store.contains(oid) or self.store.contains(oid):
+            return True
+        if self.refs.is_owned(oid):
+            e = self._entry(oid)
+            return e.state in ("ready", "error")
+        try:
+            r = self._run(self.pool.get(ref.owner.addr).call(
+                "locate", oid=oid, timeout=5.0))
+            return r["status"] in ("ready", "error")
+        except Exception:
+            return False
+
+    # ------------------------------------------------------ function shipping
+
+    def export_function(self, fn: Any) -> bytes:
+        """ref: function_manager.py:61 — pickled code via GCS KV, lazy import."""
+        blob = cloudpickle.dumps(fn)
+        fid = hashlib.sha1(blob).digest()
+        if fid not in self._exported:
+            self.kv_put("fn", fid, blob, overwrite=False)
+            self._exported.add(fid)
+            self._fn_cache[fid] = fn
+        return fid
+
+    def load_function(self, fid: bytes) -> Any:
+        fn = self._fn_cache.get(fid)
+        if fn is None:
+            blob = self.kv_get("fn", fid)
+            if blob is None:
+                raise RuntimeError(f"function {fid.hex()[:12]} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[fid] = fn
+        return fn
+
+    # ------------------------------------------------------- task submission
+
+    def submit_task(self, fn: Callable, args: tuple, kwargs: dict, *,
+                    name: str = "", num_returns: int = 1,
+                    resources: Optional[ResourceSet] = None,
+                    max_retries: Optional[int] = None,
+                    retry_exceptions: bool = False,
+                    scheduling: Optional[SchedulingStrategy] = None) -> List[ObjectRef]:
+        """ref: CoreWorker::SubmitTask core_worker.cc:1855."""
+        fid = self.export_function(fn)
+        task_id = TaskID(os_urandom4() + b"\x00" * 8 + self.job_id.binary())
+        spec_args, arg_ids = self._pack_args(args, kwargs)
+        mr = self.cfg.task_max_retries_default if max_retries is None else max_retries
+        spec = TaskSpec(
+            task_id=task_id, name=name or getattr(fn, "__name__", "task"),
+            func_id=fid, args=spec_args, num_returns=num_returns,
+            resources=resources or ResourceSet({"CPU": 1.0}),
+            owner=self.address, job_id=self.job_id, max_retries=mr,
+            retry_exceptions=retry_exceptions,
+            scheduling=scheduling or SchedulingStrategy())
+        refs = self._register_returns(spec, arg_ids)
+        self._submit_spec(spec, retries_left=mr)
+        return refs
+
+    def _register_returns(self, spec: TaskSpec, arg_ids: List[ObjectID]) -> List[ObjectRef]:
+        refs = []
+        for rid in spec.return_ids():
+            e = self._entry(rid)
+            e.spec = spec                      # lineage
+            self.refs.register_owned(rid)
+            refs.append(ObjectRef(rid, self.address))
+        self.refs.on_task_submitted(arg_ids)
+        self._inflight[spec.task_id] = _PendingTask(spec, spec.max_retries)
+        self._record_event(spec, "PENDING")
+        return refs
+
+    def _pack_args(self, args: tuple, kwargs: dict):
+        """Inline small values; pass ObjectRefs as deps
+        (ref: dependency_resolver.h inlining)."""
+        spec_args: List[Tuple[str, Any]] = []
+        arg_ids: List[ObjectID] = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                spec_args.append(("ref", (a.id, a.owner)))
+                arg_ids.append(a.id)
+            else:
+                spec_args.append(("v", serialization.pack(a)))
+        kw = {}
+        for k, a in kwargs.items():
+            if isinstance(a, ObjectRef):
+                kw[k] = ("ref", (a.id, a.owner))
+                arg_ids.append(a.id)
+            else:
+                kw[k] = ("v", serialization.pack(a))
+        if kw:
+            spec_args.append(("kw", kw))
+        return spec_args, arg_ids
+
+    def _submit_spec(self, spec: TaskSpec, retries_left: int):
+        self._inflight.setdefault(spec.task_id, _PendingTask(spec, retries_left))
+        cls = spec.scheduling_class()
+        self._queues[cls].append(spec)
+        self._spawn(self._pump_class(cls))
+
+    async def _pump_class(self, cls: Tuple):
+        """One pump == one leased worker draining this class's queue. Each
+        submission spawns a pump, so parallelism grows with queue depth (the
+        nodelet's worker pool is the actual cap); a pump that wins no work
+        returns its lease immediately. ref: direct_task_transport.cc:346
+        RequestNewWorkerIfNeeded + pipelining onto leased workers :588."""
+        q = self._queues[cls]
+        if not q:
+            return
+        self._class_pending_lease[cls] += 1
+        try:
+            lw = await self._acquire_lease(q[0])
+        except Exception:
+            logger.exception("lease acquisition failed")
+            lw = None
+        finally:
+            self._class_pending_lease[cls] -= 1
+        if lw is None:
+            if q and not self._shutdown:
+                await asyncio.sleep(0.2)
+                if self._queues[cls]:
+                    self._spawn(self._pump_class(cls))
+            return
+        self._class_leases[cls].append(lw)
+        try:
+            while True:
+                try:
+                    spec = q.popleft()
+                except IndexError:
+                    break
+                await self._push_and_handle(spec, lw, cls)
+        finally:
+            self._class_leases[cls].remove(lw)
+            await self._return_lease(lw)
+
+    async def _acquire_lease(self, spec: TaskSpec) -> Optional[_LeasedWorker]:
+        target = self.nodelet_addr
+        pg = None
+        if spec.scheduling.kind == "PLACEMENT_GROUP":
+            pg = (spec.scheduling.pg_id, spec.scheduling.bundle_index)
+        if spec.scheduling.kind == "NODE_AFFINITY":
+            r = await self.pool.get(self.gcs_addr).call(
+                "pick_node", resources=spec.resources, strategy_kind="DEFAULT")
+            # affinity handled by GCS in actor path; tasks: resolve node addr
+            nodes = await self.pool.get(self.gcs_addr).call("get_nodes")
+            for n in nodes:
+                if n.node_id == spec.scheduling.node_id:
+                    target = n.nodelet_addr
+                    break
+        for _ in range(16):  # bounded spillback hops
+            try:
+                r = await self.pool.get(tuple(target)).call(
+                    "request_lease", resources=spec.resources, pg=pg,
+                    timeout=self.cfg.worker_lease_timeout_s + 10.0)
+            except (ConnectionLost, RemoteError, OSError) as e:
+                logger.warning("lease request to %s failed: %s", target, e)
+                await asyncio.sleep(0.2)
+                continue
+            st = r["status"]
+            if st == "granted":
+                return _LeasedWorker(r["lease_id"], r["worker_addr"], tuple(target),
+                                     r["worker_id"])
+            if st == "spillback":
+                target = tuple(r["addr"])
+                continue
+            if st == "retry":
+                await asyncio.sleep(0.05)
+                continue
+            if st == "infeasible":
+                # Same scheduling class == same resource demand: the whole
+                # queue is infeasible (ref: infeasible queue surfaced to
+                # autoscaler; without one, surface the error to callers).
+                q = self._queues[spec.scheduling_class()]
+                failed = {spec.task_id}
+                self._fail_task_returns(
+                    spec, RuntimeError(f"infeasible task: {r.get('error')}"))
+                while q:
+                    s = q.popleft()
+                    if s.task_id not in failed:
+                        self._fail_task_returns(
+                            s, RuntimeError(f"infeasible task: {r.get('error')}"))
+                return None
+        return None
+
+    async def _return_lease(self, lw: _LeasedWorker):
+        try:
+            await self.pool.get(lw.nodelet_addr).call("return_lease",
+                                                      lease_id=lw.lease_id, timeout=5.0)
+        except Exception:
+            pass
+
+    async def _push_and_handle(self, spec: TaskSpec, lw: _LeasedWorker, cls: Tuple):
+        self._record_event(spec, "RUNNING")
+        try:
+            result: TaskResult = await self.pool.get(lw.worker_addr).call(
+                "push_task", spec=spec)
+        except (ConnectionLost, RemoteError, OSError) as e:
+            pt = self._inflight.get(spec.task_id)
+            if pt is not None and pt.retries_left > 0:
+                pt.retries_left -= 1
+                logger.warning("task %s worker died (%s); retrying (%d left)",
+                               spec.name, e, pt.retries_left)
+                self._record_event(spec, "FAILED_RETRYING")
+                self._queues[cls].append(spec)
+                self._spawn(self._pump_class(cls))
+            else:
+                self._fail_task_returns(spec, WorkerCrashedError(
+                    f"worker died running {spec.name}: {e}"))
+            return
+        self._complete_task(spec, result, cls)
+
+    def _complete_task(self, spec: TaskSpec, result: TaskResult, cls: Optional[Tuple]):
+        app_error = None
+        for (kind, payload), rid in zip(result.returns, spec.return_ids()):
+            if kind == "err":
+                app_error = payload
+                break
+        if app_error is not None and spec.retry_exceptions:
+            pt = self._inflight.get(spec.task_id)
+            if pt is not None and pt.retries_left > 0:
+                pt.retries_left -= 1
+                self._record_event(spec, "FAILED_RETRYING")
+                self._queues[cls].append(spec)
+                self._spawn(self._pump_class(cls))
+                return
+        for (kind, payload), rid in zip(result.returns, spec.return_ids()):
+            e = self._entry(rid)
+            if kind == "inline":
+                e.inline = payload
+                try:
+                    self.memory_store.put(rid, serialization.unpack(payload))
+                except Exception:
+                    pass
+            elif kind == "store":
+                e.locations.add(tuple(payload))
+            elif kind == "err":
+                e.error = payload
+                e.state = "error"
+                self.memory_store.put(rid, payload)
+            if e.state != "error":
+                e.state = "ready"
+            e.event.set()
+        self._record_event(spec, "FAILED" if app_error else "FINISHED")
+        self._inflight.pop(spec.task_id, None)
+        arg_ids = [p[0] for (k, p) in spec.args if k == "ref"]
+        self.refs.on_task_done(arg_ids)
+
+    def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
+        ser = serialization.SerializedException(exc, "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)))
+        for rid in spec.return_ids():
+            e = self._entry(rid)
+            e.error = ser
+            e.state = "error"
+            e.event.set()
+            self.memory_store.put(rid, ser)
+        self._record_event(spec, "FAILED")
+        self._inflight.pop(spec.task_id, None)
+
+    # ----------------------------------------------------------------- actors
+
+    def create_actor(self, cls: type, args: tuple, kwargs: dict, *,
+                     name: Optional[str] = None, namespace: str = "default",
+                     resources: Optional[ResourceSet] = None,
+                     max_restarts: int = 0, max_concurrency: int = 1,
+                     scheduling: Optional[SchedulingStrategy] = None,
+                     lifetime: Optional[str] = None) -> ActorID:
+        """ref: CoreWorker::CreateActor core_worker.cc:1922 → GCS RegisterActor."""
+        fid = self.export_function(cls)
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.of(actor_id)
+        spec_args, arg_ids = self._pack_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id, name=getattr(cls, "__name__", "Actor"),
+            func_id=fid, args=spec_args, num_returns=0,
+            resources=resources or ResourceSet({"CPU": 1.0}),
+            owner=self.address, job_id=self.job_id,
+            scheduling=scheduling or SchedulingStrategy(),
+            is_actor_creation=True, actor_id=actor_id,
+            max_restarts=max_restarts, max_concurrency=max_concurrency,
+            actor_name=name, namespace=namespace)
+        self.refs.on_task_submitted(arg_ids)
+        r = self.gcs_call("register_actor", spec=spec)
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "actor registration failed"))
+        self._actor_addr[actor_id] = None
+        self._subscribe_actor(actor_id)
+        return actor_id
+
+    def _subscribe_actor(self, actor_id: ActorID):
+        async def _sub():
+            try:
+                await self.pool.get(self.gcs_addr).call(
+                    "subscribe", channel=f"actor:{actor_id.hex()}",
+                    addr=self.address.addr, timeout=5.0)
+            except Exception:
+                pass
+        self._spawn(_sub())
+
+    async def rpc_pubsub_message(self, channel: str, message: Any):
+        if channel.startswith("actor:"):
+            aid = ActorID.from_hex(channel.split(":", 1)[1])
+            self._actor_state[aid] = message
+            self._actor_addr[aid] = tuple(message["address"]) if message.get("address") else None
+            ev = self._actor_events.get(aid)
+            if ev:
+                ev.set()
+        elif channel == "log":
+            self._on_log(message)
+
+    def _on_log(self, message: dict):
+        pass  # driver overrides via api layer
+
+    def _resolve_actor(self, actor_id: ActorID, timeout: float = 60.0) -> Address:
+        addr = self._actor_addr.get(actor_id)
+        if addr is not None:
+            return addr
+        st = self._actor_state.get(actor_id)
+        if st is not None and st.get("state") == "DEAD":
+            raise ActorDiedError(f"actor {actor_id.hex()[:12]} is dead: "
+                                 f"{st.get('death_cause')}")
+        r = self.gcs_call("wait_actor_alive", actor_id=actor_id, wait_timeout=timeout,
+                          rpc_timeout=timeout + 10.0)
+        view = r.get("view")
+        if view is not None:
+            self._actor_state[actor_id] = view
+        if not r.get("ok"):
+            cause = (view or {}).get("death_cause", "not alive in time")
+            raise ActorDiedError(f"actor {actor_id.hex()[:12]}: {cause}")
+        self._actor_addr[actor_id] = tuple(view["address"])
+        return self._actor_addr[actor_id]
+
+    def submit_actor_call(self, actor_id: ActorID, method_name: str,
+                          args: tuple, kwargs: dict, *, num_returns: int = 1,
+                          max_task_retries: int = 0) -> List[ObjectRef]:
+        """ref: CoreWorker::SubmitActorTask core_worker.cc:2156 + ordered
+        actor submit queues (transport/actor_submit_queue.h)."""
+        task_id = TaskID.of(actor_id)
+        spec_args, arg_ids = self._pack_args(args, kwargs)
+        self._actor_seq[actor_id] += 1
+        spec = TaskSpec(
+            task_id=task_id, name=method_name, func_id=b"", args=spec_args,
+            num_returns=num_returns, resources=ResourceSet({}),
+            owner=self.address, job_id=self.job_id,
+            is_actor_call=True, actor_id=actor_id, method_name=method_name,
+            seq_no=self._actor_seq[actor_id], max_retries=max_task_retries)
+        refs = self._register_returns(spec, arg_ids)
+        self._actor_queue(actor_id).append((spec, max_task_retries))
+        self._spawn(self._actor_sender(actor_id))
+        return refs
+
+    def _actor_queue(self, actor_id: ActorID) -> deque:
+        q = self._actor_queues.get(actor_id)
+        if q is None:
+            q = self._actor_queues[actor_id] = deque()
+        return q
+
+    async def _actor_sender(self, actor_id: ActorID):
+        """Single in-flight sender per actor: frames hit the wire in seq order
+        (TCP FIFO) and the actor worker executes FIFO, giving the ordered
+        semantics of the reference's sequence-numbered actor submit queue
+        (transport/actor_submit_queue.h). Replies are handled concurrently
+        (pipelining)."""
+        if self._actor_sending.get(actor_id):
+            return
+        self._actor_sending[actor_id] = True
+        try:
+            q = self._actor_queue(actor_id)
+            while q:
+                spec, retries = q.popleft()
+                try:
+                    addr = await asyncio.get_running_loop().run_in_executor(
+                        None, self._resolve_actor, actor_id)
+                except (ActorDiedError, ActorUnavailableError) as e:
+                    self._fail_task_returns(spec, e)
+                    continue
+                client = self.pool.get(tuple(addr))
+                try:
+                    fut = await client.start_call("push_actor_task", spec=spec)
+                except (ConnectionLost, OSError) as e:
+                    await self._on_actor_push_failure(spec, retries, addr, e)
+                    continue
+                self.loop.create_task(
+                    self._handle_actor_reply(spec, retries, addr, fut))
+        finally:
+            self._actor_sending[actor_id] = False
+            if self._actor_queue(actor_id):
+                self._spawn(self._actor_sender(actor_id))
+
+    async def _handle_actor_reply(self, spec: TaskSpec, retries: int,
+                                  addr: Address, fut):
+        try:
+            result: TaskResult = await fut
+        except (ConnectionLost, RemoteError, OSError) as e:
+            await self._on_actor_push_failure(spec, retries, addr, e)
+            return
+        self._complete_task(spec, result, None)
+
+    async def _on_actor_push_failure(self, spec: TaskSpec, retries: int,
+                                     addr: Address, err: Exception):
+        """Worker connection broke: the actor may be restarting
+        (ref: direct_actor_task_submitter.h DisconnectActor/retry path)."""
+        actor_id = spec.actor_id
+        if self._actor_addr.get(actor_id) == tuple(addr):
+            self._actor_addr[actor_id] = None
+        self.pool.drop(tuple(addr))
+        if isinstance(err, RemoteError):
+            # Handler raised (not a transport failure): surface to caller.
+            self._fail_task_returns(spec, err)
+            return
+        try:
+            view = await self.pool.get(self.gcs_addr).call(
+                "get_actor", actor_id=actor_id, timeout=10.0)
+        except Exception:
+            view = None
+        state = (view or {}).get("state")
+        if retries != 0 and state != "DEAD":
+            await asyncio.sleep(0.3)
+            self._actor_queue(actor_id).append(
+                (spec, retries - 1 if retries > 0 else -1))
+            self._spawn(self._actor_sender(actor_id))
+        elif state in ("RESTARTING", "ALIVE", "PENDING_CREATION"):
+            self._fail_task_returns(spec, ActorUnavailableError(
+                f"actor {actor_id.hex()[:12]} unavailable: {err}"))
+        else:
+            cause = (view or {}).get("death_cause", str(err))
+            self._fail_task_returns(spec, ActorDiedError(
+                f"actor {actor_id.hex()[:12]} died: {cause}"))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.gcs_call("kill_actor", actor_id=actor_id, no_restart=no_restart)
+
+    # -------------------------------------------- ownership protocol (server)
+
+    async def rpc_wait_object(self, oid: ObjectID, wait_timeout: float = 30.0) -> dict:
+        e = self._entry(oid)
+        loop = asyncio.get_running_loop()
+        ok = await loop.run_in_executor(None, e.event.wait, wait_timeout)
+        if not ok:
+            return {"status": "pending"}
+        if e.state == "error":
+            return {"status": "error", "error": e.error}
+        if e.state == "lost":
+            return {"status": "lost"}
+        if e.inline is not None:
+            return {"status": "ready", "inline": e.inline}
+        v = self.memory_store.get_if_exists(oid)
+        if v is not _MISSING and not isinstance(v, serialization.SerializedException):
+            return {"status": "ready", "inline": serialization.pack(v)}
+        return {"status": "ready", "inline": None,
+                "locations": [list(a) for a in e.locations]}
+
+    async def rpc_locate(self, oid: ObjectID) -> dict:
+        with self._dir_lock:
+            e = self.directory.get(oid)
+        if e is None:
+            return {"status": "unknown"}
+        return {"status": e.state, "locations": [list(a) for a in e.locations]}
+
+    async def rpc_add_borrow(self, oid: ObjectID, borrower_id: bytes) -> dict:
+        self.refs.add_borrower(oid, borrower_id)
+        return {"ok": True}
+
+    async def rpc_remove_borrow(self, oid: ObjectID, borrower_id: bytes) -> dict:
+        self.refs.remove_borrower(oid, borrower_id)
+        return {"ok": True}
+
+    async def rpc_ping(self) -> dict:
+        return {"ok": True, "worker_id": self.worker_id}
+
+    # -------------------------------------------------------------- telemetry
+
+    def _record_event(self, spec: TaskSpec, state: str):
+        """ref: task_event_buffer.h:199 — bounded buffer, flushed to GCS."""
+        self._task_events.append({
+            "task_id": spec.task_id.hex(), "name": spec.name, "state": state,
+            "job_id": self.job_id, "ts": time.time(),
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None})
+        if len(self._task_events) >= 100:
+            self.flush_task_events()
+
+    def flush_task_events(self):
+        evs, self._task_events = self._task_events, []
+        if not evs:
+            return
+        async def _send():
+            try:
+                await self.pool.get(self.gcs_addr).call("add_task_events",
+                                                        events=evs, timeout=5.0)
+            except Exception:
+                pass
+        self._spawn(_send())
+
+    # ------------------------------------------------------------------ misc
+
+    def as_future(self, ref: ObjectRef) -> SyncFuture:
+        fut: SyncFuture = SyncFuture()
+
+        def _bg():
+            try:
+                fut.set_result(self._get_one(ref, None))
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=_bg, daemon=True).start()
+        return fut
+
+
+def os_urandom4() -> bytes:
+    import os as _os
+
+    return _os.urandom(4)

@@ -1,0 +1,163 @@
+"""Object serialization: cloudpickle + pickle5 out-of-band buffers.
+
+Reference: python/ray/_private/serialization.py:108 (SerializationContext) —
+cloudpickle metadata with pickle-protocol-5 out-of-band buffers enabling
+zero-copy numpy/Arrow reads straight from the plasma segment. We reproduce
+that layout and add jax.Array awareness: device arrays are pulled to host
+(numpy) on serialize — the HBM tier keeps device buffers per-process, the
+shared store holds only host bytes.
+
+Wire layout of a stored object:
+    [u32 n_buffers][u64 meta_len][meta (cloudpickle, with PickleBuffer
+    placeholders)] then for each buffer: pad-to-64 [u64 len][payload]
+Deserialization maps each payload as a zero-copy memoryview into the shm
+segment, so numpy arrays returned by `get` alias store memory (read-only).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+import numpy as np
+
+_ALIGN = 64
+_HDR = struct.Struct("<IQ")
+_LEN = struct.Struct("<Q")
+
+
+def _is_jax_array(x) -> bool:
+    t = type(x)
+    mod = t.__module__
+    return mod.startswith("jax") and t.__name__ in ("ArrayImpl", "Array")
+
+
+class _JaxArrayReducer:
+    """Moves jax.Arrays device->host at serialize time.
+
+    They deserialize as numpy; the consumer re-places them onto its own mesh
+    (device placement is never implicit across process boundaries — on TPU,
+    sharding is a property of the consuming program, not the bytes).
+    """
+
+
+def _pre_dump(obj: Any) -> Any:
+    return obj
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
+    """Returns (meta, out_of_band_buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+
+    def _reduce_jax(arr):
+        return np.asarray(arr)  # device -> host, then numpy takes the oob path
+
+    def buffer_cb(buf: pickle.PickleBuffer) -> bool:
+        buffers.append(buf)
+        return False  # serialize out-of-band
+
+    import copyreg
+
+    # cloudpickle honours dispatch via the Pickler subclass; simplest robust
+    # route: map jax arrays to numpy before pickling via a custom pickler.
+    class _P(cloudpickle.Pickler):
+        def persistent_id(self, o):
+            return None
+
+        def reducer_override(self, o):
+            if _is_jax_array(o):
+                arr = np.asarray(o)
+                return (np.asarray, (arr,))
+            return NotImplemented
+
+    import io
+
+    f = io.BytesIO()
+    p = _P(f, protocol=5, buffer_callback=buffer_cb)
+    p.dump(obj)
+    meta = f.getvalue()
+    return meta, [b.raw() for b in buffers]
+
+
+def serialized_size(meta: bytes, buffers: List[memoryview]) -> int:
+    n = _HDR.size + len(meta)
+    for b in buffers:
+        n = _aligned(n) + _LEN.size + b.nbytes
+    return n
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def write_to(view: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
+    """Writes the wire layout into `view`; returns bytes written."""
+    _HDR.pack_into(view, 0, len(buffers), len(meta))
+    off = _HDR.size
+    view[off:off + len(meta)] = meta
+    off += len(meta)
+    for b in buffers:
+        off = _aligned(off)
+        _LEN.pack_into(view, off, b.nbytes)
+        off += _LEN.size
+        view[off:off + b.nbytes] = b.cast("B")
+        off += b.nbytes
+    return off
+
+
+def pack(obj: Any) -> bytes:
+    meta, bufs = serialize(obj)
+    out = bytearray(serialized_size(meta, bufs))
+    write_to(memoryview(out), meta, bufs)
+    return bytes(out)
+
+
+def read_from(view: memoryview) -> Any:
+    """Zero-copy deserialize from a stored object's memory."""
+    n_buffers, meta_len = _HDR.unpack_from(view, 0)
+    off = _HDR.size
+    meta = view[off:off + meta_len]
+    off += meta_len
+    bufs = []
+    for _ in range(n_buffers):
+        off = _aligned(off)
+        (blen,) = _LEN.unpack_from(view, off)
+        off += _LEN.size
+        bufs.append(view[off:off + blen])
+        off += blen
+    return pickle.loads(meta, buffers=bufs)
+
+
+def unpack(data: bytes) -> Any:
+    return read_from(memoryview(data))
+
+
+# --- exception transport ----------------------------------------------------
+
+
+class SerializedException:
+    """Wrapper so exceptions raised in workers re-raise at the caller.
+
+    Reference: python/ray/exceptions.py RayTaskError — the remote traceback
+    string travels with the exception and is appended to the local one.
+    """
+
+    def __init__(self, exc: BaseException, tb_str: str):
+        try:
+            self.payload = pack(exc)
+            self.unpicklable = False
+        except Exception:
+            self.payload = pack(RuntimeError(f"{type(exc).__name__}: {exc}"))
+            self.unpicklable = True
+        self.tb_str = tb_str
+
+    def to_exception(self) -> BaseException:
+        from ray_tpu.core.status import TaskError
+
+        try:
+            cause = unpack(self.payload)
+        except Exception as e:  # cause class not importable at caller
+            cause = RuntimeError(f"(undeserializable task error: {e})")
+        return TaskError(cause, self.tb_str)

@@ -1,0 +1,59 @@
+"""Error and exception model.
+
+Reference: src/ray/common/status.h (C++ Status codes) and
+python/ray/exceptions.py (user-facing exception taxonomy). One module here:
+the Python layer is the only consumer in ray_tpu, the native store reports
+errors via return codes.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised; carries the remote traceback (ref: RayTaskError)."""
+
+    def __init__(self, cause: BaseException, remote_tb: str):
+        self.cause = cause
+        self.remote_tb = remote_tb
+        super().__init__(f"{type(cause).__name__}: {cause}\n--- remote traceback ---\n{remote_tb}")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing a task died (ref: WorkerCrashedError)."""
+
+
+class ActorDiedError(RayTpuError):
+    """Actor is dead and (re)start budget is exhausted (ref: RayActorError)."""
+
+
+class ActorUnavailableError(RayTpuError):
+    """Actor is restarting; call may be retried (ref: ActorUnavailableError)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value was lost and could not be reconstructed
+    (ref: ObjectLostError / ObjectReconstructionFailedError)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get(..., timeout=) expired (ref: GetTimeoutError)."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Host shm tier full and nothing evictable (ref: ObjectStoreFullError)."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Worker environment failed to materialize (ref: RuntimeEnvSetupError)."""
+
+
+class PlacementGroupUnavailableError(RayTpuError):
+    """Gang reservation infeasible with current cluster shape."""
+
+
+class NodeDiedError(RayTpuError):
+    """Node lost (health-check failure) while hosting the referenced entity."""

@@ -1,0 +1,243 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference: the execution half of src/ray/core_worker/ — scheduling queues
+(transport/normal_scheduling_queue.h, actor_scheduling_queue.h), concurrency
+groups/fibers for async actors (fiber.h), and the Python task execution
+handler in _raylet.pyx. One process == one Worker; the asyncio loop runs in
+the main thread (RPC serving + async actor methods), synchronous task/actor
+code runs on executor threads (1 thread => FIFO ordered actor semantics;
+max_concurrency > 1 => threaded actor).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.common import ObjectRef, RuntimeAddress, TaskResult, TaskSpec
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import JobID, NodeID, TaskID
+from ray_tpu.core.runtime import Runtime, set_runtime
+from ray_tpu.core.serialization import SerializedException
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+class Worker:
+    """RPC handler for the worker process; delegates ownership-protocol
+    methods to the embedded Runtime (every worker is also an owner)."""
+
+    def __init__(self, runtime: Runtime):
+        self.runtime = runtime
+        self.task_executor = ThreadPoolExecutor(max_workers=1,
+                                                thread_name_prefix="task-exec")
+        self.actor_instance: Any = None
+        self.actor_spec: Optional[TaskSpec] = None
+        self._async_sem: Optional[asyncio.Semaphore] = None
+
+    def __getattr__(self, name):
+        # Delegate rpc_wait_object / rpc_locate / rpc_add_borrow / ... to the
+        # runtime so one server serves both protocols.
+        return getattr(self.runtime, name)
+
+    # ---------------------------------------------------------------- execute
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        args: List[Any] = []
+        kwargs: dict = {}
+        ref_args: List[Tuple[int, ObjectRef]] = []
+        for kind, payload in spec.args:
+            if kind == "v":
+                args.append(serialization.unpack(payload))
+            elif kind == "ref":
+                oid, owner = payload
+                args.append(ObjectRef(oid, owner))
+            elif kind == "kw":
+                for k, (kk, pv) in payload.items():
+                    if kk == "v":
+                        kwargs[k] = serialization.unpack(pv)
+                    else:
+                        oid, owner = pv
+                        kwargs[k] = ObjectRef(oid, owner)
+        # Dependency resolution: refs are fetched before user code runs
+        # (ref: _raylet.pyx deserializes args via plasma before execution).
+        args = [self.runtime.get([a])[0] if isinstance(a, ObjectRef) else a
+                for a in args]
+        kwargs = {k: (self.runtime.get([v])[0] if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _package_returns(self, spec: TaskSpec, values: Any) -> TaskResult:
+        n = spec.num_returns
+        if n == 0:
+            return TaskResult(spec.task_id, [])
+        if n == 1:
+            values = (values,)
+        elif not isinstance(values, tuple) or len(values) != n:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={n} but returned "
+                f"{type(values).__name__}")
+        returns = []
+        for i, v in enumerate(values):
+            rid = spec.return_ids()[i]
+            meta, bufs = serialization.serialize(v)
+            size = serialization.serialized_size(meta, bufs)
+            if size <= self.runtime.cfg.max_direct_call_object_size:
+                packed = bytearray(size)
+                serialization.write_to(memoryview(packed), meta, bufs)
+                returns.append(("inline", bytes(packed)))
+            else:
+                store = self.runtime.store
+                view = store.create_view(rid, size)
+                if view is not None:
+                    serialization.write_to(view, meta, bufs)
+                    del view
+                    store.seal(rid)
+                    pin = store.get_view(rid)  # pin the primary copy
+                    if pin is not None:
+                        self.runtime._pinned.setdefault(rid, pin)
+                elif not store.contains(rid):
+                    raise MemoryError(f"object store full storing return {i}")
+                returns.append(("store", self.runtime.nodelet_addr))
+        return TaskResult(spec.task_id, returns)
+
+    def _execute(self, spec: TaskSpec, fn=None) -> TaskResult:
+        """Runs on an executor thread — NEVER on the asyncio loop: it blocks
+        on GCS KV fetches and dependency gets, which are loop-driven."""
+        self.runtime.set_exec_context(spec.task_id)
+        try:
+            if fn is None:
+                fn = self.runtime.load_function(spec.func_id)
+            args, kwargs = self._resolve_args(spec)
+            value = fn(*args, **kwargs)
+            return self._package_returns(spec, value)
+        except BaseException as e:
+            tb = traceback.format_exc()
+            ser = SerializedException(e, tb)
+            return TaskResult(spec.task_id,
+                              [("err", ser)] * max(1, spec.num_returns))
+        finally:
+            self.runtime.clear_exec_context()
+
+    # ------------------------------------------------------------ rpc surface
+
+    async def rpc_push_task(self, spec: TaskSpec) -> TaskResult:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.task_executor, self._execute, spec)
+
+    async def rpc_create_actor(self, spec: TaskSpec) -> dict:
+        self.actor_spec = spec
+        if spec.max_concurrency > 1:
+            self.task_executor = ThreadPoolExecutor(
+                max_workers=spec.max_concurrency, thread_name_prefix="actor-exec")
+        self._async_sem = asyncio.Semaphore(max(1, spec.max_concurrency))
+
+        def _ctor():
+            self.runtime.set_exec_context(spec.task_id)
+            try:
+                cls = self.runtime.load_function(spec.func_id)
+                args, kwargs = self._resolve_args(spec)
+                self.actor_instance = cls(*args, **kwargs)
+                return {"ok": True}
+            except BaseException:
+                return {"ok": False, "error": traceback.format_exc()}
+            finally:
+                self.runtime.clear_exec_context()
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.task_executor, _ctor)
+
+    async def rpc_push_actor_task(self, spec: TaskSpec) -> TaskResult:
+        if self.actor_instance is None:
+            raise RuntimeError("no actor hosted here")
+        method = getattr(self.actor_instance, spec.method_name, None)
+        if method is None:
+            def method(*a, **k):
+                raise AttributeError(
+                    f"actor has no method {spec.method_name!r}")
+        if inspect.iscoroutinefunction(method):
+            # async actor: method coroutine runs on the loop (ref: fibers,
+            # fiber.h); arg resolution still happens off-loop because it may
+            # block on remote gets.
+            async with self._async_sem:
+                loop = asyncio.get_running_loop()
+                try:
+                    args, kwargs = await loop.run_in_executor(
+                        self.task_executor, self._resolve_args, spec)
+                    self.runtime.set_exec_context(spec.task_id)
+                    value = await method(*args, **kwargs)
+                    return self._package_returns(spec, value)
+                except BaseException as e:
+                    ser = SerializedException(e, traceback.format_exc())
+                    return TaskResult(spec.task_id,
+                                      [("err", ser)] * max(1, spec.num_returns))
+                finally:
+                    self.runtime.clear_exec_context()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self.task_executor, self._execute,
+                                          spec, method)
+
+    async def rpc_exit_worker(self, reason: str = "") -> dict:
+        logger.info("worker exiting: %s", reason)
+        asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))
+        return {"ok": True}
+
+
+async def worker_main(args):
+    cfg = Config.from_json(args.config)
+    gh, gp = args.gcs.rsplit(":", 1)
+    nh, np_ = args.nodelet.rsplit(":", 1)
+    loop = asyncio.get_running_loop()
+    runtime = Runtime(cfg, (gh, int(gp)), (nh, int(np_)), args.store,
+                      JobID.nil(), mode="worker", loop=loop,
+                      worker_id=bytes.fromhex(args.worker_id))
+    set_runtime(runtime)
+    worker = Worker(runtime)
+    runtime.server.handler = worker
+    host, port = await runtime.server.start()
+    runtime.address = RuntimeAddress(host, port, runtime.worker_id)
+    r = await runtime.pool.get(runtime.nodelet_addr).call(
+        "register_worker", worker_id=runtime.worker_id, addr=(host, port),
+        timeout=cfg.rpc_connect_timeout_s)
+    if not r.get("ok"):
+        logger.error("nodelet rejected registration; exiting")
+        return
+    logger.info("worker %s serving on %s:%d", args.worker_id[:8], host, port)
+    # Exit if the nodelet disappears (parent supervision).
+    nodelet = runtime.pool.get(runtime.nodelet_addr)
+    while True:
+        await asyncio.sleep(5.0)
+        try:
+            await nodelet.call("ping", timeout=5.0)
+        except Exception:
+            logger.warning("nodelet unreachable; worker exiting")
+            return
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodelet", required=True)
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--store", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--config", default="{}")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {args.worker_id[:8]}] %(asctime)s %(levelname)s %(message)s")
+    asyncio.run(worker_main(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Native (C++) components of ray_tpu.
+
+Currently: the shared-memory object store (objstore.cc), the host tier of the
+object plane (reference: src/ray/object_manager/plasma/). Compiled lazily on
+first import so a fresh checkout needs no separate build step.
+"""
+
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+OBJSTORE_SO = os.path.join(_HERE, "libraytpu_objstore.so")
+
+
+def ensure_built() -> str:
+    """Compile the native library if missing or older than its source."""
+    src = os.path.join(_HERE, "objstore.cc")
+    if (not os.path.exists(OBJSTORE_SO)
+            or os.path.getmtime(OBJSTORE_SO) < os.path.getmtime(src)):
+        subprocess.run(
+            ["make", "-C", _HERE, "all"],
+            check=True,
+            capture_output=True,
+        )
+    return OBJSTORE_SO

@@ -1,0 +1,17 @@
+"""ray_tpu.util — user-facing utilities.
+
+Reference: python/ray/util/ (ActorPool, queue, placement groups, scheduling
+strategies, metrics, collective).
+"""
+
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          remove_placement_group)
+from ray_tpu.util.scheduling_strategies import (NodeAffinitySchedulingStrategy,
+                                                PlacementGroupSchedulingStrategy)
+from ray_tpu.util.actor_pool import ActorPool
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+    "ActorPool",
+]

@@ -1,0 +1,52 @@
+"""ActorPool: round-robin work distribution over a fixed actor fleet.
+
+Reference: python/ray/util/actor_pool.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending = []           # (fn, value) waiting for an idle actor
+        self._result_queue = []
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+        else:
+            self._pending.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending)
+
+    def get_next(self, timeout=None):
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ready, _ = self._ray.wait(list(self._future_to_actor), num_returns=1,
+                                  timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result ready in time")
+        ref = ready[0]
+        actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        while self._pending and self._idle:
+            fn, value = self._pending.pop(0)
+            a = self._idle.pop()
+            self._future_to_actor[fn(a, value)] = a
+        return self._ray.get(ref)
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()

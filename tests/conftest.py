@@ -1,0 +1,43 @@
+"""Shared fixtures.
+
+- JAX tests run on a virtual 8-device CPU mesh (the axon/TPU plugin is
+  disabled for the test session so xla_force_host_platform_device_count
+  takes effect) — the reference's cluster_utils fake-topology idea applied
+  to devices (SURVEY.md §4.2).
+- Cluster fixtures mirror python/ray/tests/conftest.py ray_start_regular /
+  ray_start_cluster.
+"""
+
+import os
+
+# Must happen before anything imports jax (including transitively).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""       # disable axon sitecustomize hook
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="function")
+def ray_start_regular():
+    import ray_tpu
+
+    info = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
+                        _system_config={"health_check_period_s": 0.2,
+                                        "worker_idle_timeout_s": 60.0})
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="function")
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False,
+                      system_config={"health_check_period_s": 0.2,
+                                     "health_check_failure_threshold": 5})
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,138 @@
+"""Core API basics: tasks, objects, wait, errors.
+
+Reference test model: python/ray/tests/test_basic.py.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.status import TaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"x": 1, "y": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"x": 1, "y": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert np.array_equal(out, arr)
+    # big objects come back zero-copy from the shm store
+    assert not out.flags.owndata
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+
+def test_task_with_ref_arg(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    a = f.remote(1)
+    b = f.remote(a)
+    c = f.remote(b)
+    assert ray_tpu.get(c) == 4
+
+
+def test_task_large_return_and_arg(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return np.ones(500_000, dtype=np.float64)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    ref = make.remote()
+    assert ray_tpu.get(total.remote(ref)) == 500_000.0
+
+
+def test_put_ref_as_task_arg(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x["k"]
+
+    ref = ray_tpu.put({"k": 7})
+    assert ray_tpu.get(f.remote(ref)) == 7
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def f():
+        return 1, 2, 3
+
+    a, b, c = f.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "kaboom" in str(ei.value)
+
+
+def test_wait(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=10)
+    assert ready == [f] and pending == [s]
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0)) == 11
+
+
+def test_many_small_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == list(range(50))
+
+
+def test_get_timeout(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0

@@ -1,0 +1,148 @@
+"""Multi-node behavior: spillback scheduling, object transfer, placement
+groups, node failure + actor restart, lineage reconstruction.
+
+Reference test models: python/ray/tests/test_multinode_failures*.py,
+test_reconstruction*.py, test_placement_group*.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group)
+
+
+def test_spillback_across_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 1.0})
+    cluster.add_node(resources={"CPU": 1.0, "gadget": 1.0})
+    cluster.connect()
+
+    @ray_tpu.remote(num_cpus=1, resources={"gadget": 1})
+    def where():
+        import os
+
+        return os.getpid()
+
+    # must run on the gadget node even though the driver's local node lacks it
+    assert isinstance(ray_tpu.get(where.remote()), int)
+
+
+def test_object_transfer_between_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 1.0, "a": 1.0})
+    cluster.add_node(resources={"CPU": 1.0, "b": 1.0})
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"a": 1})
+    def produce():
+        return np.arange(300_000, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"b": 1})
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    expected = float(np.arange(300_000, dtype=np.float64).sum())
+    assert ray_tpu.get(consume.remote(ref)) == expected
+    # and the driver can read it too (pull to its node)
+    assert float(ray_tpu.get(ref).sum()) == expected
+
+
+def test_placement_group_pack_and_task(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 2.0})
+    cluster.add_node(resources={"CPU": 2.0})
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=15)
+
+    @ray_tpu.remote(num_cpus=1,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=0))
+    def inside():
+        return "ok"
+
+    assert ray_tpu.get(inside.remote()) == "ok"
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 1.0})
+    cluster.add_node(resources={"CPU": 1.0})
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=15)
+    table = pg.table()
+    nodes = {b["node_id"].hex() for b in table["bundles"]}
+    assert len(nodes) == 2
+
+
+def test_placement_group_infeasible_pending(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 1.0})
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 64}], strategy="PACK")
+    assert not pg.ready(timeout=1.5)
+
+
+def test_node_death_actor_restart(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 2.0})           # driver's node
+    doomed = cluster.add_node(resources={"CPU": 2.0, "doomed": 1.0})
+    cluster.connect()
+
+    @ray_tpu.remote
+    class Survivor:
+        def where(self):
+            import os
+
+            return os.getpid()
+
+    a = Survivor.options(
+        max_restarts=2, max_task_retries=4,
+        resources={"doomed": 0.001}).remote()
+    pid1 = ray_tpu.get(a.where.remote())
+    cluster.remove_node(doomed)
+    # After the health-check threshold the GCS restarts the actor elsewhere —
+    # but "doomed" only existed there, so give the restart a fallback:
+    # (actor resources keep requiring doomed; expect DEAD instead)
+    deadline = time.time() + 20
+    saw_failure = False
+    while time.time() < deadline:
+        try:
+            ray_tpu.get(a.where.remote(), timeout=5)
+        except Exception:
+            saw_failure = True
+            break
+        time.sleep(0.3)
+    assert saw_failure
+
+
+def test_lineage_reconstruction(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 2.0})           # stable node (driver)
+    volatile = cluster.add_node(resources={"CPU": 2.0, "volatile": 1.0})
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"volatile": 0.001}, max_retries=2)
+    def produce():
+        return np.ones(300_000, dtype=np.float64)     # big -> store-resident
+
+    ref = produce.remote()
+    assert float(ray_tpu.get(ref).sum()) == 300_000.0
+    # Kill the node holding the only copy. The object is lost; a later get
+    # must re-execute the producing task via lineage — but the task needs
+    # "volatile", which died with the node, so reconstruction must surface
+    # ObjectLostError... unless we give it somewhere to go:
+    cluster.add_node(resources={"CPU": 2.0, "volatile": 1.0})
+    time.sleep(1.0)
+    cluster.remove_node(volatile)
+    time.sleep(2.0)
+    out = ray_tpu.get(ref, timeout=60)
+    assert float(out.sum()) == 300_000.0
