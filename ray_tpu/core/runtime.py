@@ -724,8 +724,10 @@ class Runtime:
         self.refs.on_task_done(arg_ids)
 
     def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
+        # System errors re-raise as themselves at the caller, not TaskError.
         ser = serialization.SerializedException(exc, "".join(
-            traceback.format_exception(type(exc), exc, exc.__traceback__)))
+            traceback.format_exception(type(exc), exc, exc.__traceback__)),
+            wrap=False)
         for rid in spec.return_ids():
             e = self._entry(rid)
             e.error = ser

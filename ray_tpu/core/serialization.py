@@ -144,7 +144,10 @@ class SerializedException:
     string travels with the exception and is appended to the local one.
     """
 
-    def __init__(self, exc: BaseException, tb_str: str):
+    def __init__(self, exc: BaseException, tb_str: str, wrap: bool = True):
+        """wrap=True: user-code exception, re-raised wrapped in TaskError with
+        the remote traceback. wrap=False: framework/system exception
+        (ActorDiedError, WorkerCrashedError, ...) re-raised as itself."""
         try:
             self.payload = pack(exc)
             self.unpicklable = False
@@ -152,6 +155,7 @@ class SerializedException:
             self.payload = pack(RuntimeError(f"{type(exc).__name__}: {exc}"))
             self.unpicklable = True
         self.tb_str = tb_str
+        self.wrap = wrap
 
     def to_exception(self) -> BaseException:
         from ray_tpu.core.status import TaskError
@@ -160,4 +164,6 @@ class SerializedException:
             cause = unpack(self.payload)
         except Exception as e:  # cause class not importable at caller
             cause = RuntimeError(f"(undeserializable task error: {e})")
+        if not self.wrap:
+            return cause
         return TaskError(cause, self.tb_str)

@@ -1,0 +1,16 @@
+"""Model zoo: pure-functional JAX models with logical sharding annotations.
+
+Every model exposes:
+    Config dataclass (+ size presets)
+    init_params(key, cfg)   -> param pytree
+    param_specs(cfg)        -> same-structure pytree of logical axis tuples
+    forward(params, tokens) -> logits          (teacher-forced, scan layers)
+    prefill / decode        -> KV-cache inference path (serve layer)
+
+Parallelism never appears in model code — it comes from
+ray_tpu.parallel.ShardingRules applied to the logical specs.
+"""
+
+from ray_tpu.models import registry
+
+__all__ = ["registry"]

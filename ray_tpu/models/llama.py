@@ -1,0 +1,306 @@
+"""Llama-family transformer (RMSNorm + RoPE + GQA + SwiGLU), TPU-first.
+
+The flagship model for the Train/Serve benchmarks (BASELINE.md configs 2-4:
+Llama-2 7B on v5e-8, Llama-3 70B on v5p-64, continuous-batched 7B serving).
+Reference analog: the reference has no in-tree LLM — its release tests defer
+to Alpa/OPT (release/alpa_tests/train_opt_2_7b_minimum.py); here the model is
+first-class so parallelism presets and Pallas kernels apply directly.
+
+Design notes (TPU):
+- layers are stacked and iterated with lax.scan => one compiled layer body,
+  O(1) compile time in depth; the stacked 'layers' dim is also what pipeline
+  parallelism shards (parallel/pipeline.py).
+- all matmuls run in bfloat16 with float32 params (casted in), biasless.
+- attention dispatch: "xla" (fused by Mosaic/XLA), "flash" (our Pallas
+  kernel, ops/flash_attention.py), "ring" (sequence-parallel ring attention,
+  ops/ring_attention.py) — chosen by RuntimeFlags, not model code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 4096
+    dtype: Any = jnp.bfloat16        # activation/compute dtype
+    param_dtype: Any = jnp.float32   # master weights
+    attn_impl: str = "xla"           # "xla" | "flash" | "ring"
+    remat: bool = True               # jax.checkpoint each layer (HBM savings)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def replace(self, **kw) -> "LlamaConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Size presets (BASELINE.md target configs).
+PRESETS: Dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, d_ff=128, max_seq_len=128),
+    "debug-125m": LlamaConfig(vocab_size=32000, d_model=768, n_layers=12,
+                              n_heads=12, n_kv_heads=12, d_ff=2048,
+                              max_seq_len=1024),
+    "1b": LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
+                      n_heads=16, n_kv_heads=8, d_ff=5632, max_seq_len=2048),
+    "7b": LlamaConfig(),  # llama-2 7B shapes
+    "70b": LlamaConfig(d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                       d_ff=28672, vocab_size=32000, max_seq_len=4096),
+}
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Logical axis names per parameter (see parallel/sharding.py)."""
+    L = ("layers",)
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": L + ("embed_nr",),
+            "wq": L + ("embed", "heads"),
+            "wk": L + ("embed", "kv_heads"),
+            "wv": L + ("embed", "kv_heads"),
+            "wo": L + ("heads", "embed"),
+            "ffn_norm": L + ("embed_nr",),
+            "w_gate": L + ("embed", "mlp"),
+            "w_up": L + ("embed", "mlp"),
+            "w_down": L + ("mlp", "embed"),
+        },
+        "final_norm": ("embed_nr",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(key, cfg: LlamaConfig) -> Dict[str, Any]:
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(key, 16))
+
+    def norm(shape):
+        return jnp.ones(shape, pd)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, pd) * (fan_in ** -0.5))
+
+    L, D, H, KV, HD, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.head_dim, cfg.d_ff)
+    return {
+        "embed": jax.random.normal(next(k), (cfg.vocab_size, D), pd) * 0.02,
+        "layers": {
+            "attn_norm": norm((L, D)),
+            "wq": dense(next(k), (L, D, H * HD), D),
+            "wk": dense(next(k), (L, D, KV * HD), D),
+            "wv": dense(next(k), (L, D, KV * HD), D),
+            "wo": dense(next(k), (L, H * HD, D), H * HD),
+            "ffn_norm": norm((L, D)),
+            "w_gate": dense(next(k), (L, D, F), D),
+            "w_up": dense(next(k), (L, D, F), D),
+            "w_down": dense(next(k), (L, F, D), F),
+        },
+        "final_norm": norm((D,)),
+        "lm_head": dense(next(k), (D, cfg.vocab_size), D),
+    }
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    D, H, KV, HD, F, L, V = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                             cfg.head_dim, cfg.d_ff, cfg.n_layers,
+                             cfg.vocab_size)
+    per_layer = 2 * D + D * H * HD + 2 * D * KV * HD + H * HD * D + 3 * D * F
+    return V * D + L * per_layer + D + D * V
+
+
+# --- building blocks --------------------------------------------------------
+
+
+def rms_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2), inline=True)
+def _rope_tables(theta: float, seq_len: int, head_dim: int):
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                             / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)                     # [S, HD/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, N, HD]; cos/sin: [S, HD/2] (already offset for decode)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def _attention_xla(q, k, v, causal: bool, q_offset=0):
+    """Plain einsum attention; XLA fuses this well on TPU for moderate S.
+    q: [B, S, H, D], k/v: [B, T, KV, D] (GQA broadcast)."""
+    B, S, H, D = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    groups = H // KV
+    q = q.reshape(B, S, KV, groups, D)
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / (D ** 0.5)
+    if causal:
+        qpos = jnp.arange(S)[:, None] + q_offset
+        kpos = jnp.arange(T)[None, :]
+        mask = qpos >= kpos
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(B, S, H, D)
+
+
+def _attention(q, k, v, cfg: LlamaConfig, causal=True, q_offset=0):
+    if cfg.attn_impl == "flash" and causal and q.shape[1] >= 128:
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name="sp")
+    return _attention_xla(q, k, v, causal, q_offset)
+
+
+def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None):
+    """One transformer block. x: [B, S, D]. cache: (k, v, offset) or None."""
+    B, S, D = x.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, HD)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, S, KV, HD)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, S, KV, HD)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_cache = None
+    if cache is not None:
+        ck, cv, offset = cache
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, offset, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, offset, 0, 0))
+        kk, vv = ck.astype(dt), cv.astype(dt)
+        # mask out cache slots beyond offset+S via causal offset
+        attn = _attention(q, kk, vv, cfg, causal=True, q_offset=offset)
+        new_cache = (ck, cv)
+    else:
+        attn = _attention(q, k, v, cfg, causal=True)
+    attn = attn.reshape(B, S, H * HD)
+    x = x + attn @ lp["wo"].astype(dt)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    return x, new_cache
+
+
+def forward(params, tokens, cfg: LlamaConfig):
+    """Teacher-forced logits. tokens: [B, S] int32 -> [B, S, vocab] f32."""
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    cos, sin = _rope_tables(cfg.rope_theta, S, cfg.head_dim)
+
+    def body(x, lp):
+        y, _ = _layer(x, lp, cfg, cos, sin)
+        return y, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig):
+    """Next-token cross-entropy. batch: {"tokens": [B, S+1]} or
+    {"inputs": [B,S], "targets": [B,S], optional "mask": [B,S]}."""
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")
+    logits = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return nll.mean()
+    mask = mask.astype(nll.dtype)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+# --- inference (KV cache) ---------------------------------------------------
+
+
+class KVCache(NamedTuple):
+    k: jax.Array        # [L, B, max_seq, KV, HD]
+    v: jax.Array
+    length: jax.Array   # [B] int32 — per-sequence filled length
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_seq: Optional[int] = None,
+               dtype=None) -> KVCache:
+    S = max_seq or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt),
+                   jnp.zeros((batch,), jnp.int32))
+
+
+def cache_specs(cfg: LlamaConfig):
+    return KVCache(("layers", None, None, "kv_heads", "head_dim"),
+                   ("layers", None, None, "kv_heads", "head_dim"),
+                   (None,))
+
+
+def forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig,
+                       offset) -> Tuple[jax.Array, KVCache]:
+    """Run [B, S] tokens at position `offset` (scalar — uniform across batch
+    for the bucketed serving path), filling the cache. Returns last-position
+    logits [B, vocab] and the updated cache."""
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    cos_full, sin_full = _rope_tables(cfg.rope_theta, cfg.max_seq_len,
+                                     cfg.head_dim)
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, offset, S, axis=0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, offset, S, axis=0)
+
+    def body(x, inp):
+        lp, ck, cv = inp
+        y, new_cache = _layer(x, lp, cfg, cos, sin, cache=(ck, cv, offset))
+        return y, new_cache
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x[:, -1, :] @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32), KVCache(nk, nv, cache.length + S)

@@ -1,0 +1,24 @@
+"""ray_tpu.parallel: one mesh abstraction, parallelism as sharding presets.
+
+This is the inversion SURVEY.md §5.8 calls for: where the reference bolts
+SPMD onto actors from outside (NCCL process groups via torch.distributed —
+train/torch/config.py:69 — or ray.util.collective), here collectives live
+*inside* jitted programs. The framework's job is mesh construction,
+sharding-rule presets (DP / FSDP / TP / PP / SP / EP), and the host-side
+bootstrap; XLA emits the psum/all-gather/reduce-scatter/ppermute over ICI.
+
+    from ray_tpu.parallel import MeshSpec, build_mesh, ShardingRules
+
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    rules = ShardingRules.fsdp_tp()
+    step = make_train_step(model, rules, mesh)   # see ray_tpu.train
+"""
+
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh, local_mesh
+from ray_tpu.parallel.sharding import (ShardingRules, logical_to_mesh,
+                                       shard_params, named_sharding)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "local_mesh", "ShardingRules",
+    "logical_to_mesh", "shard_params", "named_sharding",
+]

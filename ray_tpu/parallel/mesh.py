@@ -1,0 +1,101 @@
+"""Device mesh construction.
+
+The single Mesh abstraction every parallelism strategy rides on
+(SURVEY.md §7.10: "Each is a sharding-rule preset over one Mesh abstraction,
+not a separate engine"). Axis names, outer (slowest/most DCN-friendly) to
+inner (most ICI-bandwidth-hungry):
+
+    dp    — pure data parallel (gradient psum only, tolerates DCN)
+    pp    — pipeline stages (point-to-point ppermute, modest bandwidth)
+    fsdp  — sharded data parallel (per-layer all-gather/reduce-scatter; ICI)
+    sp    — sequence/context parallel (ring attention neighbor exchange; ICI)
+    tp    — tensor parallel (activation all-reduce every layer; innermost ICI)
+    ep    — expert parallel is NOT a separate axis: experts shard over
+            ('dp','fsdp') (see sharding.py EP preset) with all-to-all routing.
+
+Axis order matters: jax.make_mesh/mesh_utils assign the innermost mesh axes
+to the most tightly ICI-coupled device dimensions, which is exactly the
+bandwidth order above (cf. the scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Sizes per axis; -1 on at most one axis means "absorb the rest"."""
+    dp: int = 1
+    pp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.pp, self.fsdp, self.sp, self.tp)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = list(self.sizes())
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if wild:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {known}")
+            sizes[wild[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(
+                f"mesh spec {tuple(sizes)} needs {known} devices, have {n_devices}")
+        return MeshSpec(*sizes)
+
+    @classmethod
+    def data_parallel(cls, n: int = -1) -> "MeshSpec":
+        return cls(dp=n)
+
+    @classmethod
+    def fsdp_only(cls, n: int = -1) -> "MeshSpec":
+        return cls(fsdp=n)
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None,
+               allow_split_physical: bool = True):
+    """Build a jax.sharding.Mesh with the canonical axis names.
+
+    Uses mesh_utils.create_device_mesh so the logical axes map onto the
+    physical ICI torus with contiguity for the inner axes.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    shape = spec.sizes()
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=list(devices),
+            allow_split_physical_axes=allow_split_physical)
+    except (ValueError, AssertionError, NotImplementedError):
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def local_mesh(spec: Optional[MeshSpec] = None):
+    """Mesh over this process's addressable devices (single-host)."""
+    import jax
+
+    devs = jax.local_devices()
+    return build_mesh(spec or MeshSpec(dp=-1), devices=devs)
+
+
+def mesh_axis_size(mesh, name: str) -> int:
+    return int(mesh.shape[name])
