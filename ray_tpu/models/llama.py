@@ -219,12 +219,20 @@ def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None):
     return x, new_cache
 
 
-def forward(params, tokens, cfg: LlamaConfig):
-    """Teacher-forced logits. tokens: [B, S] int32 -> [B, S, vocab] f32."""
+def forward(params, tokens, cfg: LlamaConfig, pos_offset=0):
+    """Teacher-forced logits. tokens: [B, S] int32 -> [B, S, vocab] f32.
+    pos_offset shifts RoPE positions (sequence-parallel shards pass their
+    global chunk offset)."""
     dt = cfg.dtype
     B, S = tokens.shape
     x = params["embed"].astype(dt)[tokens]
-    cos, sin = _rope_tables(cfg.rope_theta, S, cfg.head_dim)
+    if isinstance(pos_offset, int) and pos_offset == 0:
+        cos, sin = _rope_tables(cfg.rope_theta, S, cfg.head_dim)
+    else:
+        cos_full, sin_full = _rope_tables(cfg.rope_theta, cfg.max_seq_len,
+                                          cfg.head_dim)
+        cos = jax.lax.dynamic_slice_in_dim(cos_full, pos_offset, S, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_full, pos_offset, S, axis=0)
 
     def body(x, lp):
         y, _ = _layer(x, lp, cfg, cos, sin)
@@ -238,7 +246,29 @@ def forward(params, tokens, cfg: LlamaConfig):
     return logits.astype(jnp.float32)
 
 
-def loss_fn(params, batch, cfg: LlamaConfig):
+def forward_sp(params, tokens, cfg: LlamaConfig, mesh):
+    """Sequence-parallel forward: seq sharded over the 'sp' mesh axis, ring
+    attention exchanging KV around the ICI ring (ops/ring_attention.py).
+    Partial-manual shard_map: only 'sp' is manual; dp/fsdp/tp stay under
+    GSPMD so the same params shardings apply unchanged."""
+    from jax.sharding import PartitionSpec as P
+
+    cfg_ring = cfg.replace(attn_impl="ring")
+    sp = int(mesh.shape["sp"])
+
+    def fwd_local(params, tok_local):
+        S_local = tok_local.shape[1]
+        offset = jax.lax.axis_index("sp") * S_local
+        return forward(params, tok_local, cfg_ring, pos_offset=offset)
+
+    return jax.shard_map(
+        fwd_local, mesh=mesh,
+        in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        axis_names={"sp"}, check_vma=False)(params, tokens)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     """Next-token cross-entropy. batch: {"tokens": [B, S+1]} or
     {"inputs": [B,S], "targets": [B,S], optional "mask": [B,S]}."""
     if "tokens" in batch:
@@ -249,7 +279,11 @@ def loss_fn(params, batch, cfg: LlamaConfig):
     else:
         inputs, targets = batch["inputs"], batch["targets"]
         mask = batch.get("mask")
-    logits = forward(params, inputs, cfg)
+    if (cfg.attn_impl == "ring" and mesh is not None
+            and int(mesh.shape.get("sp", 1)) > 1):
+        logits = forward_sp(params, inputs, cfg, mesh)
+    else:
+        logits = forward(params, inputs, cfg)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is None:

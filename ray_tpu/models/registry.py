@@ -1,0 +1,32 @@
+"""Model registry: name -> (config presets, init/forward/loss fns).
+
+Gives Train/Serve/bench one switchboard:
+    cfg, mod = registry.get("llama", "tiny")
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Tuple
+
+_FAMILIES = {
+    "llama": "ray_tpu.models.llama",
+    "gpt2": "ray_tpu.models.gpt2",
+    "moe": "ray_tpu.models.moe",
+}
+
+
+def get(family: str, preset: str) -> Tuple[Any, Any]:
+    """Returns (config, module). Module exposes init_params/forward/loss_fn/
+    param_specs."""
+    if family not in _FAMILIES:
+        raise KeyError(f"unknown model family {family!r}; have {sorted(_FAMILIES)}")
+    mod = importlib.import_module(_FAMILIES[family])
+    presets = getattr(mod, "PRESETS")
+    if preset not in presets:
+        raise KeyError(f"unknown {family} preset {preset!r}; have {sorted(presets)}")
+    return presets[preset], mod
+
+
+def families():
+    return sorted(_FAMILIES)

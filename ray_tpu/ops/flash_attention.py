@@ -1,0 +1,196 @@
+"""Fused causal attention (flash-style) for TPU in Pallas.
+
+Forward: one kernel instance per (batch, head, q-block); the q-block stays in
+VMEM while K/V stream through in chunks with the online-softmax recurrence —
+O(S) memory instead of O(S^2), and the QK^T / PV matmuls hit the MXU at
+[block_q x head_dim] x [head_dim x block_k] granularity.
+
+Backward: memory-bounded chunked recompute in plain JAX (lax.scan over k
+chunks) using the saved log-sum-exp from the forward kernel. XLA fuses this
+into tight loops; a full Pallas backward is a later-round optimization.
+
+GQA is handled in the kernel via the k/v index maps (kv_head = head // group)
+— no KV broadcast materialization.
+
+Shapes: q [B, S, H, D], k/v [B, T, KV, D], output [B, S, H, D].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                scale: float, causal: bool):
+    # q_ref: [1, 1, block_q, D]; k_ref/v_ref: [1, 1, T, D]
+    block_q, D = q_ref.shape[2], q_ref.shape[3]
+    T = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(ki, carry):
+        o, m, l = carry
+        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        o_new = o * alpha + jax.lax.dot(p, v,
+                                        preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    if causal:
+        # only k-blocks at or before this q-block contribute
+        num_k = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    else:
+        num_k = T // block_k
+    o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
+    B, S, H, D = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    groups = H // KV
+    scale = D ** -0.5
+    # layout: [B, H, S, D] per-instance slices
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+    grid = (B, H, S // block_q)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
+                          causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, T, D),
+                         lambda b, h, i, g=groups: (b, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, T, D),
+                         lambda b, h, i, g=groups: (b, h // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+def _reference_chunked_bwd(res, g, *, causal: bool, chunk: int):
+    """Recompute-based backward, chunked over the key axis to stay O(S*chunk)
+    in memory. Uses the forward's lse so probabilities are exact."""
+    q, k, v, out, lse = res
+    B, S, H, D = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    groups = H // KV
+    scale = D ** -0.5
+
+    qf = q.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(of * gf, axis=-1)                  # [B, S, H]
+
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    kg = kf[:, :, :, None, :]                           # [B,T,KV,1,D]
+    vg = vf[:, :, :, None, :]
+    q5 = qf.reshape(B, S, KV, groups, D)
+    g5 = gf.reshape(B, S, KV, groups, D)
+    lse5 = lse.transpose(0, 2, 1).reshape(B, S, KV, groups)
+    delta5 = delta.reshape(B, S, KV, groups)
+    q_pos = jnp.arange(S)
+
+    nchunks = max(1, T // chunk)
+    csize = T // nchunks
+
+    def body(carry, ci):
+        dq_acc = carry
+        ks = jax.lax.dynamic_slice_in_dim(kg, ci * csize, csize, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(vg, ci * csize, csize, axis=1)
+        s = jnp.einsum("bskgd,btkud->bskgt", q5, ks) * scale  # u==1 squeezed
+        if causal:
+            k_pos = ci * csize + jnp.arange(csize)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, :, None, None, :], s, NEG_INF)
+        p = jnp.exp(s - lse5[..., None])                     # [B,S,KV,G,c]
+        dv_c = jnp.einsum("bskgt,bskgd->btkd", p, g5)
+        dp = jnp.einsum("bskgd,btkud->bskgt", g5, vs)
+        ds = p * (dp - delta5[..., None]) * scale
+        dq_c = jnp.einsum("bskgt,btkud->bskgd", ds, ks)
+        dk_c = jnp.einsum("bskgt,bskgd->btkd", ds, q5)
+        return dq_acc + dq_c, (dk_c, dv_c)
+
+    dq0 = jnp.zeros_like(q5)
+    dq, (dk_chunks, dv_chunks) = jax.lax.scan(body, dq0, jnp.arange(nchunks))
+    dk = jnp.moveaxis(dk_chunks, 0, 1).reshape(B, T, KV, D)
+    dv = jnp.moveaxis(dv_chunks, 0, 1).reshape(B, T, KV, D)
+    return (dq.reshape(B, S, H, D).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_k=block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+    return _reference_chunked_bwd(res, g, causal=causal, chunk=block_k * 4)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
+                    block_k: int = 256):
+    """q [B,S,H,D], k/v [B,T,KV,D] -> [B,S,H,D]. S, T must divide blocks
+    (pad upstream); returns in q.dtype."""
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, k.shape[1])
+    while S % block_q:
+        block_q //= 2
+    while k.shape[1] % block_k:
+        block_k //= 2
+    return _flash(q, k, v, causal, max(block_q, 1), max(block_k, 1))

@@ -11,7 +11,7 @@
 import os
 
 # Must happen before anything imports jax (including transitively).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""       # disable axon sitecustomize hook
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -41,3 +41,13 @@ def ray_start_cluster():
                                      "health_check_failure_threshold": 5})
     yield cluster
     cluster.shutdown()
+
+
+# The axon sitecustomize may have imported jax and pinned the axon platform
+# before this conftest ran; force the CPU backend at the config level too.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

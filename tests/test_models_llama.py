@@ -1,0 +1,115 @@
+"""Llama model + sharded train step on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from ray_tpu.models import llama  # noqa: E402
+from ray_tpu.parallel import (MeshSpec, ShardingRules, build_mesh)  # noqa: E402
+from ray_tpu.parallel.train_step import (make_train_state_init,  # noqa: E402
+                                         make_train_step)
+
+CFG = llama.PRESETS["tiny"].replace(remat=False, dtype=jnp.float32)
+
+
+def test_forward_shapes():
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = llama.forward(params, tokens, CFG)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert jnp.isfinite(logits).all()
+
+
+def test_causality():
+    """Changing future tokens must not change past logits."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    t2 = t1.at[0, 5:].set(9)
+    l1 = llama.forward(params, t1, CFG)
+    l2 = llama.forward(params, t2, CFG)
+    np.testing.assert_allclose(np.asarray(l1[0, :5]), np.asarray(l2[0, :5]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kv_cache_matches_forward():
+    params = llama.init_params(jax.random.PRNGKey(1), CFG)
+    B, S = 2, 12
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0,
+                                CFG.vocab_size)
+    full = llama.forward(params, tokens, CFG)
+
+    cache = llama.init_cache(CFG, B, max_seq=32)
+    # prefill first 8, then decode one at a time
+    logits, cache = llama.forward_with_cache(params, tokens[:, :8], cache,
+                                             CFG, 0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 7]),
+                               rtol=2e-4, atol=2e-4)
+    for i in range(8, S):
+        logits, cache = llama.forward_with_cache(params, tokens[:, i:i + 1],
+                                                 cache, CFG, i)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, i]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("rules_name,mesh_spec", [
+    ("dp", MeshSpec(dp=8)),
+    ("fsdp", MeshSpec(dp=2, fsdp=4)),
+    ("fsdp_tp", MeshSpec(dp=2, fsdp=2, tp=2)),
+])
+def test_sharded_training_loss_decreases(rules_name, mesh_spec):
+    mesh = build_mesh(mesh_spec)
+    rules = getattr(ShardingRules, rules_name)()
+    cfg = CFG
+    optimizer = optax.adamw(1e-2)
+
+    init_fn, state_sh = make_train_state_init(
+        lambda k: llama.init_params(k, cfg), optimizer, mesh, rules,
+        llama.param_specs(cfg))
+    state = init_fn(jax.random.PRNGKey(0))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), optimizer,
+                           mesh, rules, state_sh,
+                           batch_shapes=jax.eval_shape(lambda: batch))
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert np.isfinite(losses).all()
+
+
+def test_sp_ring_training_step():
+    """Sequence parallelism: rules 'full' with sp=4; the model's ring
+    attention path must produce finite grads and match dp-only loss."""
+    cfg = CFG.replace(attn_impl="ring")
+    mesh = build_mesh(MeshSpec(dp=2, sp=4))
+    rules = ShardingRules.full()
+    optimizer = optax.sgd(1e-2)
+    init_fn, state_sh = make_train_state_init(
+        lambda k: llama.init_params(k, cfg), optimizer, mesh, rules,
+        llama.param_specs(cfg))
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size)
+    # sp shards the seq dim: use explicit inputs/targets of length 32 (=sp*8)
+    batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+    from ray_tpu.parallel.train_step import make_train_step as mts
+
+    params_host = jax.device_get(state.params)   # before donation
+    step = mts(lambda p, b: llama.loss_fn(p, b, cfg, mesh=mesh), optimizer, mesh, rules,
+               state_sh, batch_shapes=jax.eval_shape(lambda: batch))
+    state2, metrics = step(state, batch)
+    sp_loss = float(metrics["loss"])
+
+    # reference: same params, xla attention, no sharding
+    cfg_ref = CFG
+    ref_loss = float(llama.loss_fn(params_host, batch, cfg_ref))
+    assert np.isfinite(sp_loss)
+    np.testing.assert_allclose(sp_loss, ref_loss, rtol=2e-3)
