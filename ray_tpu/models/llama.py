@@ -268,6 +268,37 @@ def forward_sp(params, tokens, cfg: LlamaConfig, mesh):
         axis_names={"sp"}, check_vma=False)(params, tokens)
 
 
+def forward_pp(params, tokens, cfg: LlamaConfig, mesh, num_microbatches=None):
+    """Pipeline-parallel forward: layers split into pp stages, GPipe
+    microbatch schedule (parallel/pipeline.py). Embedding/head run outside
+    the pipelined trunk under plain GSPMD."""
+    from ray_tpu.parallel.pipeline import pipeline_trunk, stack_stages
+
+    pp = int(mesh.shape["pp"])
+    M = num_microbatches or max(2 * pp, 1)
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    cos, sin = _rope_tables(cfg.rope_theta, S, cfg.head_dim)
+
+    def stage_fn(stage_layers, x):
+        def body(x, lp):
+            y, _ = _layer(x, lp, cfg, cos, sin)
+            return y, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, stage_layers)
+        return x
+
+    stacked = stack_stages(params["layers"], pp)
+    trunk = pipeline_trunk(stage_fn, mesh, M)
+    x = trunk(stacked, x)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
 def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     """Next-token cross-entropy. batch: {"tokens": [B, S+1]} or
     {"inputs": [B,S], "targets": [B,S], optional "mask": [B,S]}."""
@@ -282,6 +313,8 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     if (cfg.attn_impl == "ring" and mesh is not None
             and int(mesh.shape.get("sp", 1)) > 1):
         logits = forward_sp(params, inputs, cfg, mesh)
+    elif mesh is not None and int(mesh.shape.get("pp", 1)) > 1:
+        logits = forward_pp(params, inputs, cfg, mesh)
     else:
         logits = forward(params, inputs, cfg)
     logp = jax.nn.log_softmax(logits, axis=-1)

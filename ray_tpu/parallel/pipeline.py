@@ -1,0 +1,98 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over the 'pp' axis.
+
+Reference has no native PP (SURVEY.md §2.4 — Alpa passthrough only). Here it
+is a collective program: every stage runs the same SPMD code inside a
+partial-manual shard_map over 'pp'; activations move stage-to-stage with
+jax.lax.ppermute (point-to-point over ICI/DCN), and jax.grad differentiates
+straight through the schedule (ppermute/scan have transpose rules), so the
+backward pipeline comes for free.
+
+Schedule: with M microbatches and P stages, T = M + P - 1 ticks; stage p
+works on microbatch (t - p) at tick t (GPipe fill/drain bubble of (P-1)/M).
+
+The model trunk must be expressible as stage_fn(stage_params, x) -> x, with
+stage_params stacked on a leading 'stages' dim sharded P('pp'). Embedding /
+head run outside the pipelined trunk under plain GSPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_trunk(stage_fn: Callable, mesh, num_microbatches: int):
+    """Returns trunk(stacked_params, x) -> y running the GPipe schedule.
+
+    stacked_params: pytree, each leaf [P_stages, ...] (sharded over 'pp').
+    x: [B, ...] activations entering stage 0; y: same shape leaving the last
+    stage (replicated over pp on exit).
+    """
+    pp = int(mesh.shape["pp"])
+    M = num_microbatches
+
+    def trunk_local(params_local, x):
+        # params_local leaves: [1, ...] (this stage's slice); x: full [B,...]
+        params_me = jax.tree.map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index("pp")
+        B = x.shape[0]
+        mb = B // M
+        xs = x.reshape((M, mb) + x.shape[1:])
+
+        ticks = M + pp - 1
+        fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+        def tick(carry, t):
+            act, outs = carry
+            # stage 0 ingests microbatch t (clamped); others take the permuted
+            # activation from the previous stage.
+            mb_idx = jnp.clip(t, 0, M - 1)
+            inp0 = jax.lax.dynamic_index_in_dim(xs, mb_idx, keepdims=False)
+            inp = jnp.where(stage == 0, inp0, act)
+            out = stage_fn(params_me, inp)
+            # last stage banks its result at slot t - (pp - 1)
+            slot = jnp.clip(t - (pp - 1), 0, M - 1)
+            valid = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, out, cur), slot, axis=0)
+            # ship activation to the next stage (no wraparound)
+            act_next = jax.lax.ppermute(out, "pp", fwd_perm)
+            return (act_next, outs), None
+
+        act0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        outs0 = jnp.zeros_like(xs)
+        (_, outs), _ = jax.lax.scan(tick, (act0, outs0),
+                                    jnp.arange(ticks))
+        # results live on the last stage only; zero elsewhere then psum to
+        # replicate across pp.
+        outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, "pp")
+        return outs.reshape(x.shape)
+
+    return jax.shard_map(
+        trunk_local, mesh=mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=P(),
+        axis_names={"pp"}, check_vma=False)
+
+
+def stack_stages(layers_params, pp: int):
+    """Reshape stacked per-layer params [L, ...] -> [pp, L//pp, ...]."""
+    def r(a):
+        L = a.shape[0]
+        assert L % pp == 0, f"n_layers {L} not divisible by pp={pp}"
+        return a.reshape((pp, L // pp) + a.shape[1:])
+
+    return jax.tree.map(r, layers_params)
+
+
+def unstack_stages(stacked):
+    def r(a):
+        return a.reshape((-1,) + a.shape[2:])
+
+    return jax.tree.map(r, stacked)

@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe trunk equivalence + end-to-end training."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from ray_tpu.models import llama  # noqa: E402
+from ray_tpu.parallel import MeshSpec, ShardingRules, build_mesh  # noqa: E402
+from ray_tpu.parallel.pipeline import pipeline_trunk, stack_stages  # noqa: E402
+from ray_tpu.parallel.train_step import (make_train_state_init,  # noqa: E402
+                                         make_train_step)
+
+CFG = llama.PRESETS["tiny"].replace(remat=False, dtype=jnp.float32,
+                                    n_layers=4)
+
+
+def test_pipeline_trunk_matches_sequential():
+    mesh = build_mesh(MeshSpec(pp=4, dp=2))
+
+    def stage_fn(w, x):
+        # w: [L_per_stage, D, D]; simple per-layer nonlinearity
+        def body(x, wi):
+            return jnp.tanh(x @ wi), None
+
+        y, _ = jax.lax.scan(body, x, w)
+        return y
+
+    L, D, B = 8, 16, 8
+    w = jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.5
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+
+    # sequential reference
+    def seq(x):
+        for i in range(L):
+            x = jnp.tanh(x @ w[i])
+        return x
+
+    ref = seq(x)
+    trunk = pipeline_trunk(stage_fn, mesh, num_microbatches=4)
+    out = jax.jit(lambda w_, x_: trunk(w_, x_))(stack_stages(w, 4), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_forward_matches_plain():
+    mesh = build_mesh(MeshSpec(pp=2, dp=4))
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                CFG.vocab_size)
+    ref = llama.forward(params, tokens, CFG)
+    out = jax.jit(lambda p, t: llama.forward_pp(p, t, CFG, mesh,
+                                                num_microbatches=2))(params,
+                                                                     tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_training_loss_decreases():
+    mesh = build_mesh(MeshSpec(pp=2, dp=2, tp=2))
+    rules = ShardingRules.fsdp_tp()
+    optimizer = optax.adamw(1e-2)
+    cfg = CFG
+    init_fn, state_sh = make_train_state_init(
+        lambda k: llama.init_params(k, cfg), optimizer, mesh, rules,
+        llama.param_specs(cfg))
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg, mesh=mesh),
+                           optimizer, mesh, rules, state_sh,
+                           batch_shapes=jax.eval_shape(lambda: batch))
+    losses = []
+    for _ in range(6):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.95, losses
