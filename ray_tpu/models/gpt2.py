@@ -1,0 +1,134 @@
+"""GPT-2 family: LayerNorm + learned positions + GELU MLP + MHA.
+
+BASELINE.md config 1: "GPT-2 125M single-host Trainer (CPU-runnable parity
+check)". Same functional conventions as llama.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import _attention_xla
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+PRESETS: Dict[str, GPT2Config] = {
+    "tiny": GPT2Config(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                       d_ff=128, max_seq_len=128),
+    "125m": GPT2Config(),
+    "350m": GPT2Config(d_model=1024, n_layers=24, n_heads=16, d_ff=4096),
+    "1.5b": GPT2Config(d_model=1600, n_layers=48, n_heads=25, d_ff=6400),
+}
+
+
+def param_specs(cfg: GPT2Config) -> Dict[str, Any]:
+    L = ("layers",)
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "layers": {
+            "ln1_g": L + ("embed_nr",), "ln1_b": L + ("embed_nr",),
+            "wqkv": L + ("embed", "heads"), "bqkv": L + ("heads",),
+            "wo": L + ("heads", "embed"), "bo": L + ("embed_nr",),
+            "ln2_g": L + ("embed_nr",), "ln2_b": L + ("embed_nr",),
+            "w1": L + ("embed", "mlp"), "b1": L + ("mlp",),
+            "w2": L + ("mlp", "embed"), "b2": L + ("embed_nr",),
+        },
+        "lnf_g": ("embed_nr",), "lnf_b": ("embed_nr",),
+    }
+
+
+def init_params(key, cfg: GPT2Config) -> Dict[str, Any]:
+    pd = cfg.param_dtype
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    k = iter(jax.random.split(key, 8))
+    init = lambda kk, shape, scale: jax.random.normal(kk, shape, pd) * scale
+    return {
+        "wte": init(next(k), (cfg.vocab_size, D), 0.02),
+        "wpe": init(next(k), (cfg.max_seq_len, D), 0.01),
+        "layers": {
+            "ln1_g": jnp.ones((L, D), pd), "ln1_b": jnp.zeros((L, D), pd),
+            "wqkv": init(next(k), (L, D, 3 * D), D ** -0.5),
+            "bqkv": jnp.zeros((L, 3 * D), pd),
+            "wo": init(next(k), (L, D, D), D ** -0.5),
+            "bo": jnp.zeros((L, D), pd),
+            "ln2_g": jnp.ones((L, D), pd), "ln2_b": jnp.zeros((L, D), pd),
+            "w1": init(next(k), (L, D, F), D ** -0.5),
+            "b1": jnp.zeros((L, F), pd),
+            "w2": init(next(k), (L, F, D), F ** -0.5),
+            "b2": jnp.zeros((L, D), pd),
+        },
+        "lnf_g": jnp.ones((D,), pd), "lnf_b": jnp.zeros((D,), pd),
+    }
+
+
+def layer_norm(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+            * g.astype(x.dtype) + b.astype(x.dtype))
+
+
+def forward(params, tokens, cfg: GPT2Config):
+    dt = cfg.dtype
+    B, S = tokens.shape
+    H, HD = cfg.n_heads, cfg.head_dim
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:S]
+
+    def body(x, lp):
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+        qkv = h @ lp["wqkv"].astype(dt) + lp["bqkv"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, HD)
+        k = k.reshape(B, S, H, HD)
+        v = v.reshape(B, S, H, HD)
+        attn = _attention_xla(q, k, v, causal=True).reshape(B, S, H * HD)
+        x = x + attn @ lp["wo"].astype(dt) + lp["bo"].astype(dt)
+        h = layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.norm_eps)
+        h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+        x = x + h @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+        return x, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+    logits = x @ params["wte"].astype(dt).T      # tied embeddings
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: GPT2Config, mesh=None):
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

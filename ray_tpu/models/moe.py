@@ -1,0 +1,163 @@
+"""Mixture-of-Experts Llama variant — the expert-parallel (EP) exercise.
+
+EP is absent from the reference (SURVEY.md §2.4 "Expert parallel: absent").
+TPU-native design: experts live on the 'experts' logical axis, sharded over
+the data axes (('dp','fsdp') by the EP rules preset). Routing uses dense
+one-hot dispatch einsums — with the expert dim sharded, XLA lowers the
+dispatch/combine contractions to all-to-all/all-gather over ICI; no ragged
+host-side routing (static shapes, MXU-friendly).
+
+Top-2 routing with capacity factor; dropped tokens pass through the residual
+(standard Switch/GShard semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama as _ll
+
+
+@dataclass(frozen=True)
+class MoEConfig(_ll.LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    router_aux_weight: float = 0.01
+
+    def replace(self, **kw) -> "MoEConfig":
+        return dataclasses.replace(self, **kw)
+
+
+PRESETS: Dict[str, MoEConfig] = {
+    "tiny": MoEConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=96, max_seq_len=128, n_experts=4,
+                      top_k=2),
+    "8x1b": MoEConfig(vocab_size=32000, d_model=2048, n_layers=16,
+                      n_heads=16, n_kv_heads=8, d_ff=5632, n_experts=8),
+}
+
+
+def param_specs(cfg: MoEConfig) -> Dict[str, Any]:
+    spec = _ll.param_specs(cfg)
+    L = ("layers",)
+    lay = dict(spec["layers"])
+    for w in ("w_gate", "w_up", "w_down"):
+        del lay[w]
+    lay["router"] = L + ("embed", "experts")
+    lay["we_gate"] = L + ("experts", "embed", "expert_mlp")
+    lay["we_up"] = L + ("experts", "embed", "expert_mlp")
+    lay["we_down"] = L + ("experts", "expert_mlp", "embed")
+    spec["layers"] = lay
+    return spec
+
+
+def init_params(key, cfg: MoEConfig) -> Dict[str, Any]:
+    params = _ll.init_params(key, cfg)
+    pd = cfg.param_dtype
+    L, D, F, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_experts
+    ks = jax.random.split(jax.random.fold_in(key, 1), 4)
+    lay = dict(params["layers"])
+    for w in ("w_gate", "w_up", "w_down"):
+        del lay[w]
+    lay["router"] = jax.random.normal(ks[0], (L, D, E), pd) * 0.02
+    lay["we_gate"] = jax.random.normal(ks[1], (L, E, D, F), pd) * D ** -0.5
+    lay["we_up"] = jax.random.normal(ks[2], (L, E, D, F), pd) * D ** -0.5
+    lay["we_down"] = jax.random.normal(ks[3], (L, E, F, D), pd) * F ** -0.5
+    params["layers"] = lay
+    return params
+
+
+def _moe_ffn(x, lp, cfg: MoEConfig):
+    """x: [B, S, D] -> ([B, S, D], aux_loss). Dense one-hot dispatch."""
+    B, S, D = x.shape
+    E, K = cfg.n_experts, cfg.top_k
+    T = B * S
+    C = max(1, int(cfg.capacity_factor * T * K / E))  # per-expert capacity
+    dt = x.dtype
+
+    xt = x.reshape(T, D)
+    logits = (xt @ lp["router"].astype(dt)).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # aux load-balancing loss (Switch): E * sum_e f_e * p_e
+    gates, idx = jax.lax.top_k(probs, K)                          # [T, K]
+    me = probs.mean(axis=0)
+    one_hot = jax.nn.one_hot(idx[:, 0], E)
+    ce = one_hot.mean(axis=0)
+    aux = cfg.router_aux_weight * E * jnp.sum(me * ce)
+
+    gates = gates / jnp.maximum(gates.sum(axis=-1, keepdims=True), 1e-9)
+
+    # position of each (token, k) within its expert's capacity buffer
+    flat_idx = idx.reshape(-1)                                    # [T*K]
+    flat_gate = gates.reshape(-1)
+    eo = jax.nn.one_hot(flat_idx, E, dtype=jnp.int32)             # [T*K, E]
+    pos = jnp.cumsum(eo, axis=0) * eo - 1                         # rank in expert
+    pos = pos.sum(axis=-1)                                        # [T*K]
+    keep = pos < C
+    flat_gate = flat_gate * keep
+
+    # dispatch tensor [T*K, E, C] one-hot -> combine with expert outputs
+    disp = (jax.nn.one_hot(flat_idx, E, dtype=dt)[:, :, None]
+            * jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C, dtype=dt)[:, None, :]
+            * keep[:, None, None].astype(dt))                     # [T*K, E, C]
+    xin = jnp.einsum("tec,td->ecd", disp,
+                     jnp.repeat(xt, K, axis=0))                   # [E, C, D]
+
+    # expert FFN (batched over E) — einsum over sharded expert dim => a2a
+    we_g = lp["we_gate"].astype(dt)
+    we_u = lp["we_up"].astype(dt)
+    we_d = lp["we_down"].astype(dt)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, we_g)) * jnp.einsum(
+        "ecd,edf->ecf", xin, we_u)
+    out_e = jnp.einsum("ecf,efd->ecd", h, we_d)                   # [E, C, D]
+
+    combine = disp * flat_gate[:, None, None].astype(dt)          # [T*K, E, C]
+    out = jnp.einsum("tec,ecd->td", combine, out_e)               # [T*K, D]
+    out = out.reshape(T, K, D).sum(axis=1)
+    return out.reshape(B, S, D), aux
+
+
+def forward(params, tokens, cfg: MoEConfig, pos_offset=0):
+    dt = cfg.dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    cos, sin = _ll._rope_tables(cfg.rope_theta, S, cfg.head_dim)
+
+    def body(carry, lp):
+        x, aux = carry
+        h = _ll.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, HD)
+        k = (h @ lp["wk"].astype(dt)).reshape(B, S, KV, HD)
+        v = (h @ lp["wv"].astype(dt)).reshape(B, S, KV, HD)
+        q = _ll.apply_rope(q, cos, sin)
+        k = _ll.apply_rope(k, cos, sin)
+        attn = _ll._attention(q, k, v, cfg, causal=True)
+        x = x + attn.reshape(B, S, H * HD) @ lp["wo"].astype(dt)
+        h = _ll.rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        moe_out, a = _moe_ffn(h, lp, cfg)
+        return (x + moe_out, aux + a), None
+
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                               params["layers"])
+    x = _ll.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32), aux
+
+
+def loss_fn(params, batch, cfg: MoEConfig, mesh=None):
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits, aux = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + aux

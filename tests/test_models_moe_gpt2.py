@@ -1,0 +1,78 @@
+"""MoE (expert parallel) + GPT-2 model tests."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from ray_tpu.models import gpt2, moe  # noqa: E402
+from ray_tpu.parallel import MeshSpec, ShardingRules, build_mesh  # noqa: E402
+from ray_tpu.parallel.train_step import (make_train_state_init,  # noqa: E402
+                                         make_train_step)
+
+
+def test_gpt2_forward_and_train():
+    cfg = gpt2.PRESETS["tiny"].replace(dtype=jnp.float32, remat=False)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    logits = gpt2.forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+
+    opt = optax.adamw(1e-2)
+    state = opt.init(params)
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(params, state):
+        loss, g = jax.value_and_grad(gpt2.loss_fn)(params, batch, cfg)
+        up, state = opt.update(g, state, params)
+        return optax.apply_updates(params, up), state, loss
+
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_moe_routing_shapes_and_grads():
+    cfg = moe.PRESETS["tiny"].replace(dtype=jnp.float32, remat=False)
+    params = moe.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    logits, aux = moe.forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert float(aux) > 0
+    g = jax.grad(lambda p: moe.loss_fn(p, {"tokens": tokens}, cfg))(params)
+    flat = jax.tree.leaves(g)
+    assert all(np.isfinite(np.asarray(x)).all() for x in flat)
+    # router must receive gradient (load balancing + gating paths)
+    assert float(jnp.abs(g["layers"]["router"]).sum()) > 0
+
+
+def test_moe_expert_parallel_training():
+    """EP preset: experts sharded over (dp, fsdp); training step runs on the
+    8-device mesh and the loss decreases."""
+    cfg = moe.PRESETS["tiny"].replace(dtype=jnp.float32, remat=False)
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    rules = ShardingRules.ep()
+    opt = optax.adamw(1e-2)
+    init_fn, state_sh = make_train_state_init(
+        lambda k: moe.init_params(k, cfg), opt, mesh, rules,
+        moe.param_specs(cfg))
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    step = make_train_step(lambda p, b: moe.loss_fn(p, b, cfg), opt, mesh,
+                           rules, state_sh,
+                           batch_shapes=jax.eval_shape(lambda: batch))
+    losses = []
+    for _ in range(6):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
