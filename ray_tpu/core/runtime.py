@@ -494,7 +494,7 @@ class Runtime:
 
     def export_function(self, fn: Any) -> bytes:
         """ref: function_manager.py:61 — pickled code via GCS KV, lazy import."""
-        blob = cloudpickle.dumps(fn)
+        blob = _dumps_function(fn)
         fid = hashlib.sha1(blob).digest()
         if fid not in self._exported:
             self.kv_put("fn", fid, blob, overwrite=False)
@@ -990,3 +990,47 @@ def os_urandom4() -> bytes:
     import os as _os
 
     return _os.urandom(4)
+
+
+def _module_is_installed(mod) -> bool:
+    """True if workers can `import mod` (stdlib / site-packages / ray_tpu)."""
+    import sys
+
+    import os
+
+    f = getattr(mod, "__file__", None)
+    if f is None:
+        return True  # builtin/frozen
+    top = mod.__name__.split(".")[0]
+    if top in ("ray_tpu", "__main__"):
+        return top == "ray_tpu"
+    f = os.path.abspath(f)
+    roots = [getattr(sys, "prefix", ""), getattr(sys, "base_prefix", "")]
+    import site
+
+    try:
+        roots.extend(site.getsitepackages())
+    except Exception:
+        pass
+    return any(r and f.startswith(r) for r in roots)
+
+
+def _dumps_function(fn) -> bytes:
+    """Pickle by reference for installed modules, by value otherwise — so
+    functions defined in user scripts/tests ship to workers that cannot
+    import their defining module (the reference gets this via
+    cloudpickle-by-value of driver code, function_manager.py)."""
+    import inspect
+
+    mod = inspect.getmodule(fn)
+    if mod is not None and mod.__name__ != "__main__" \
+            and not _module_is_installed(mod):
+        try:
+            cloudpickle.register_pickle_by_value(mod)
+            try:
+                return cloudpickle.dumps(fn)
+            finally:
+                cloudpickle.unregister_pickle_by_value(mod)
+        except Exception:
+            pass
+    return cloudpickle.dumps(fn)

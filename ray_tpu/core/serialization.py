@@ -70,6 +70,19 @@ def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
             if _is_jax_array(o):
                 arr = np.asarray(o)
                 return (np.asarray, (arr,))
+            import types
+
+            if isinstance(o, (types.FunctionType, type)):
+                from ray_tpu.core.runtime import (_dumps_function,
+                                                  _module_is_installed)
+                import inspect
+
+                mod = inspect.getmodule(o)
+                if (mod is not None and mod.__name__ != "__main__"
+                        and not _module_is_installed(mod)):
+                    # functions/classes from user scripts the executing
+                    # worker cannot import: embed by value
+                    return (cloudpickle.loads, (_dumps_function(o),))
             return NotImplemented
 
     import io

@@ -1,0 +1,34 @@
+"""ray_tpu.train: distributed training on TPU slices.
+
+Reference: python/ray/train/ — BaseTrainer.fit (base_trainer.py:570),
+DataParallelTrainer (data_parallel_trainer.py:58), BackendExecutor
+(backend_executor.py:45), WorkerGroup (worker_group.py:100), _TrainSession
+(session.py:84). The architecture carries over — trainer → placement group →
+worker-group of actors → per-worker session — but the collective plane is
+inverted (SURVEY.md §5.8): instead of `_setup_torch_process_group` wiring
+NCCL (torch/config.py:69), the JaxBackend initializes jax.distributed (multi-
+host) and builds the device mesh; all collectives live inside the jitted
+step. DP/FSDP/TP/PP/SP/EP arrive via ray_tpu.parallel sharding presets, not
+separate trainer classes.
+
+    from ray_tpu.train import JaxTrainer, ScalingConfig, RunConfig
+    from ray_tpu.train import session
+
+    def train_loop(config):
+        mesh = session.get_mesh()
+        ...
+        session.report({"loss": ...}, checkpoint=...)
+
+    result = JaxTrainer(train_loop, scaling_config=ScalingConfig(...)).fit()
+"""
+
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.trainer import JaxTrainer, Result
+from ray_tpu.train import session
+
+__all__ = [
+    "JaxTrainer", "Result", "ScalingConfig", "RunConfig", "FailureConfig",
+    "CheckpointConfig", "Checkpoint", "session",
+]

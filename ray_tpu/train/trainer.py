@@ -1,0 +1,152 @@
+"""JaxTrainer: fit() orchestration with failure recovery.
+
+Reference: python/ray/train/data_parallel_trainer.py:58 +
+base_trainer.py:570 fit + backend_executor.py failure handling
+(get_with_failure_handling:564, _restart:625). One trainer class covers what
+the reference splits into TorchTrainer/TensorflowTrainer/...: the framework
+backend is always JAX, and parallelism comes from ScalingConfig.mesh/rules.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.status import ActorDiedError, ActorUnavailableError, TaskError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    metrics_history: List[dict] = field(default_factory=list)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.loop = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from = resume_from_checkpoint
+
+    def _run_dir(self) -> str:
+        base = self.run_config.storage_path or os.path.expanduser(
+            "~/ray_tpu_results")
+        name = self.run_config.name or f"run_{int(time.time())}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def fit(self) -> Result:
+        run_dir = self._run_dir()
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        checkpoint = self.resume_from
+        result = Result()
+        while True:
+            try:
+                return self._fit_once(run_dir, checkpoint, result)
+            except (ActorDiedError, ActorUnavailableError,
+                    ray_tpu.exceptions.WorkerCrashedError,
+                    ray_tpu.exceptions.NodeDiedError) as e:
+                attempt += 1
+                # resume from the newest checkpoint any attempt produced
+                ck = Checkpoint(result.metrics.get("_checkpoint", "")) \
+                    if result.metrics.get("_checkpoint") else checkpoint
+                checkpoint = _latest_checkpoint(run_dir) or ck
+                if max_failures >= 0 and attempt > max_failures:
+                    result.error = f"worker group failed: {e}"
+                    return result
+
+    def _fit_once(self, run_dir: str, checkpoint: Optional[Checkpoint],
+                  result: Result) -> Result:
+        group = WorkerGroup(self.scaling.num_workers,
+                            self.scaling.worker_resources())
+        try:
+            # dataset shards: one DataIterator per rank (ref: session.py:901)
+            shards: List[Dict[str, Any]] = _split_datasets(
+                self.datasets, self.scaling.num_workers)
+            coordinator = None
+            if self.scaling.num_workers > 1:
+                info = ray_tpu.get(group.workers[0].host_info.remote())
+                coordinator = f"{info['hostname']}:{29891}"
+            setup_refs = [
+                w.setup.remote(self.config, run_dir, self.scaling, checkpoint,
+                               shards[i], coordinator,
+                               self.run_config.checkpoint_config.num_to_keep)
+                for i, w in enumerate(group.workers)]
+            ray_tpu.get(setup_refs)
+            run_refs = [w.run.remote(self.loop, self.config)
+                        for w in group.workers]
+            seen = 0
+            while True:
+                poll = ray_tpu.get(group.workers[0].poll.remote(seen))
+                for r in poll["reports"]:
+                    result.metrics_history.append(r)
+                    result.metrics = r
+                seen += len(poll["reports"])
+                if poll["error"]:
+                    result.error = poll["error"]
+                    break
+                if poll["finished"]:
+                    break
+                ready, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs),
+                                        timeout=0.25)
+                if len(ready) == len(run_refs):
+                    # drain any last reports
+                    poll = ray_tpu.get(group.workers[0].poll.remote(seen))
+                    for r in poll["reports"]:
+                        result.metrics_history.append(r)
+                        result.metrics = r
+                    break
+            # surface user exceptions (TaskError) from any worker
+            for ref in run_refs:
+                try:
+                    ray_tpu.get(ref, timeout=30)
+                except TaskError as e:
+                    result.error = str(e)
+                    break
+            if result.metrics.get("_checkpoint"):
+                result.checkpoint = Checkpoint(result.metrics["_checkpoint"])
+            else:
+                result.checkpoint = _latest_checkpoint(run_dir)
+            return result
+        finally:
+            group.shutdown()
+
+
+def _latest_checkpoint(run_dir: str) -> Optional[Checkpoint]:
+    from ray_tpu.train.checkpoint import CheckpointManager
+
+    return CheckpointManager(run_dir).latest()
+
+
+def _split_datasets(datasets: Dict[str, Any], n: int) -> List[Dict[str, Any]]:
+    shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+    for name, ds in datasets.items():
+        if hasattr(ds, "streaming_split"):
+            its = ds.streaming_split(n)
+            for i in range(n):
+                shards[i][name] = its[i]
+        else:
+            for i in range(n):
+                shards[i][name] = ds
+    return shards

@@ -1,0 +1,133 @@
+"""WorkerGroup: a gang of training actors under one placement group.
+
+Reference: python/ray/train/_internal/worker_group.py:100 and
+backend_executor.py:45 (_create_placement_group:164, rank assignment:272).
+The backend hook replaces NCCL process groups with jax.distributed + mesh
+setup (JaxBackend) — on a TPU slice, worker i is host i of the slice, and
+the in-step collectives need no framework plumbing at all.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.session import TrainContext, _set_context
+from ray_tpu.util import (PlacementGroupSchedulingStrategy, placement_group,
+                          remove_placement_group)
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """Hosts the user's train loop; polled by the trainer for reports.
+
+    max_concurrency=2: one thread runs the loop, the other serves polls
+    (the reference streams TrainingResults back through the backend executor
+    queue, backend_executor.py:457)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self.ctx: Optional[TrainContext] = None
+        self.error: Optional[str] = None
+        self.result: Any = None
+
+    def setup(self, config: dict, run_dir: str, scaling, checkpoint,
+              datasets, coordinator: Optional[str] = None,
+              num_to_keep=None) -> bool:
+        # Multi-host: bring up the jax distributed runtime so all hosts of
+        # the slice form one XLA computation domain (replaces
+        # _setup_torch_process_group, train/torch/config.py:69).
+        if coordinator and self.world_size > 1:
+            import jax
+
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=self.world_size,
+                                       process_id=self.rank)
+        self.ctx = TrainContext(
+            world_rank=self.rank, world_size=self.world_size, config=config,
+            run_dir=run_dir, scaling=scaling, checkpoint=checkpoint,
+            datasets=datasets, num_to_keep=num_to_keep)
+        _set_context(self.ctx)
+        return True
+
+    def run(self, loop_fn: Callable, config: dict) -> Any:
+        try:
+            self.result = loop_fn(config) if _accepts_arg(loop_fn) else loop_fn()
+            return self.result
+        except BaseException as e:
+            import traceback
+
+            self.error = traceback.format_exc()
+            raise
+        finally:
+            if self.ctx is not None:
+                self.ctx.finished = True
+
+    def poll(self, after: int) -> dict:
+        ctx = self.ctx
+        reports: List[dict] = []
+        if ctx is not None:
+            with ctx.report_lock:
+                reports = ctx.reports[after:]
+        return {"reports": reports, "finished": ctx.finished if ctx else False,
+                "error": self.error,
+                "latest_checkpoint": (ctx.latest_checkpoint.path
+                                      if ctx and ctx.latest_checkpoint else None)}
+
+    def host_info(self) -> dict:
+        import socket
+
+        return {"hostname": socket.gethostname(), "pid": os.getpid(),
+                "rank": self.rank}
+
+
+def _accepts_arg(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+        return len(sig.parameters) >= 1
+    except (TypeError, ValueError):
+        return False
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK"):
+        self.num_workers = num_workers
+        self.resources = resources_per_worker
+        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
+        self.pg = placement_group(bundles, strategy=placement_strategy)
+        if not self.pg.ready(timeout=60):
+            remove_placement_group(self.pg)
+            raise ray_tpu.exceptions.PlacementGroupUnavailableError(
+                f"could not reserve {num_workers} x {resources_per_worker}")
+        self.workers = []
+        for rank in range(num_workers):
+            w = TrainWorker.options(
+                num_cpus=0,
+                resources={k: v for k, v in resources_per_worker.items()},
+                max_concurrency=2,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg,
+                    placement_group_bundle_index=rank),
+            ).remote(rank, num_workers)
+            self.workers.append(w)
+
+    def broadcast(self, method: str, *args, **kwargs):
+        refs = [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+        return ray_tpu.get(refs)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass
