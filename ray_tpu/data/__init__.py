@@ -1,0 +1,25 @@
+"""ray_tpu.data: block-based datasets with streaming execution.
+
+Reference: python/ray/data/ — Dataset as a lazy logical plan over blocks
+flowing as object refs (SURVEY.md §1 L7), executed with bounded in-flight
+tasks (the backpressure idea of _internal/execution/streaming_executor.py:49
+reduced to a windowed pull loop), and train ingest via per-rank split
+iterators (_internal/iterator/stream_split_iterator.py).
+
+Blocks are dict-of-numpy (tabular) or Python lists (simple); they live in
+the shared-memory object store and move zero-copy into consumers. The TPU
+twist is at the edge: `DataIterator.iter_device_batches` double-buffers
+jax.device_put so the input pipeline overlaps the SPMD step (SURVEY.md §7.7).
+"""
+
+from ray_tpu.data.dataset import (Dataset, DataIterator, from_items,
+                                  from_numpy, from_pandas, range as range_,
+                                  read_csv, read_json, read_parquet)
+
+# `range` shadows the builtin deliberately, matching the reference API
+range = range_
+
+__all__ = [
+    "Dataset", "DataIterator", "from_items", "from_numpy", "from_pandas",
+    "range", "read_csv", "read_json", "read_parquet",
+]

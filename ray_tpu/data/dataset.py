@@ -1,0 +1,441 @@
+"""Dataset: lazy plan over blocks, windowed streaming execution.
+
+Reference map (python/ray/data/):
+  Dataset/logical plan        -> Dataset._ops list (dataset.py:385 map_batches)
+  StreamingExecutor           -> _StreamIterator windowed task pool
+                                 (streaming_executor.py:49, backpressure via
+                                 a max-in-flight window instead of object
+                                 store budgets)
+  DataIterator / train ingest -> DataIterator.iter_batches / split()
+  datasources                 -> read_parquet/csv/json via pyarrow
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Union)
+
+import numpy as np
+
+Block = Union[Dict[str, np.ndarray], list]
+
+_DEFAULT_BLOCK_ROWS = 4096
+_WINDOW = 4  # max in-flight transform tasks per iterator (backpressure)
+
+
+def _block_rows(b: Block) -> int:
+    if isinstance(b, dict):
+        return len(next(iter(b.values()))) if b else 0
+    return len(b)
+
+
+def _block_slice(b: Block, lo: int, hi: int) -> Block:
+    if isinstance(b, dict):
+        return {k: v[lo:hi] for k, v in b.items()}
+    return b[lo:hi]
+
+
+def _block_concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if _block_rows(b)]
+    if not blocks:
+        return []
+    if isinstance(blocks[0], dict):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out: list = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def _apply_op(block: Block, op: tuple) -> Block:
+    kind, fn = op[0], op[1]
+    if kind == "map_batches":
+        return fn(block)
+    if kind == "map":
+        if isinstance(block, dict):
+            rows = _rows_of(block)
+            out = [fn(r) for r in rows]
+            return _rows_to_block(out)
+        return [fn(r) for r in block]
+    if kind == "filter":
+        if isinstance(block, dict):
+            rows = _rows_of(block)
+            out = [r for r in rows if fn(r)]
+            return _rows_to_block(out)
+        return [r for r in block if fn(r)]
+    if kind == "flat_map":
+        rows = _rows_of(block) if isinstance(block, dict) else block
+        out: list = []
+        for r in rows:
+            out.extend(fn(r))
+        return _rows_to_block(out) if isinstance(block, dict) else out
+    raise ValueError(f"unknown op {kind}")
+
+
+def _rows_of(block: Dict[str, np.ndarray]) -> List[dict]:
+    keys = list(block.keys())
+    n = _block_rows(block)
+    return [{k: block[k][i] for k in keys} for i in builtins.range(n)]
+
+
+def _rows_to_block(rows: List[Any]) -> Block:
+    if rows and isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        try:
+            return {k: np.asarray([r[k] for r in rows]) for k in keys}
+        except Exception:
+            return rows
+    return rows
+
+
+def _transform_block(block: Block, ops: List[tuple]) -> Block:
+    for op in ops:
+        block = _apply_op(block, op)
+    return block
+
+
+class Dataset:
+    """Immutable, lazy. Transformations append ops; execution happens on
+    iteration/materialize via remote tasks over blocks."""
+
+    def __init__(self, block_refs: List[Any], ops: Optional[List[tuple]] = None):
+        self._block_refs = block_refs
+        self._ops = ops or []
+
+    # ---- transformations (lazy) -------------------------------------------
+
+    def map_batches(self, fn: Callable[[Block], Block]) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [("map_batches", fn)])
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [("map", fn)])
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [("filter", fn)])
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [("flat_map", fn)])
+
+    # ---- execution ---------------------------------------------------------
+
+    def _executed_refs(self) -> List[Any]:
+        """Launch transform tasks for all blocks (full materialize path)."""
+        import ray_tpu
+
+        if not self._ops:
+            return list(self._block_refs)
+        ops = self._ops
+
+        @ray_tpu.remote
+        def _t(block):
+            return _transform_block(block, ops)
+
+        return [_t.remote(ref) for ref in self._block_refs]
+
+    def materialize(self) -> "Dataset":
+        import ray_tpu
+
+        refs = self._executed_refs()
+        ray_tpu.wait(refs, num_returns=len(refs))
+        return Dataset(refs, [])
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        """Streaming pull: at most _WINDOW transform tasks in flight
+        (the backpressure loop of streaming_executor_state.py)."""
+        import ray_tpu
+
+        ops = self._ops
+        if not ops:
+            for ref in self._block_refs:
+                yield ray_tpu.get(ref)
+            return
+
+        @ray_tpu.remote
+        def _t(block):
+            return _transform_block(block, ops)
+
+        pending: List[Any] = []
+        it = iter(self._block_refs)
+        for ref in itertools.islice(it, _WINDOW):
+            pending.append(_t.remote(ref))
+        for ref in it:
+            yield ray_tpu.get(pending.pop(0))
+            pending.append(_t.remote(ref))
+        for p in pending:
+            yield ray_tpu.get(p)
+
+    # ---- consumption -------------------------------------------------------
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for block in self._iter_blocks():
+            rows = _rows_of(block) if isinstance(block, dict) else block
+            out.extend(rows[:n - len(out)])
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for block in self._iter_blocks():
+            out.extend(_rows_of(block) if isinstance(block, dict) else block)
+        return out
+
+    def count(self) -> int:
+        import ray_tpu
+
+        if not self._ops:
+            @ray_tpu.remote
+            def _n(b):
+                return _block_rows(b)
+
+            return sum(ray_tpu.get([_n.remote(r) for r in self._block_refs]))
+        return sum(_block_rows(b) for b in self._iter_blocks())
+
+    def schema(self) -> Optional[List[str]]:
+        for b in self._iter_blocks():
+            if isinstance(b, dict):
+                return list(b.keys())
+            return None
+        return None
+
+    def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
+                     local_shuffle_seed: Optional[int] = None):
+        return DataIterator(self._block_refs, self._ops).iter_batches(
+            batch_size=batch_size, drop_last=drop_last,
+            local_shuffle_seed=local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self._iter_blocks():
+            yield from (_rows_of(b) if isinstance(b, dict) else b)
+
+    # ---- reorganization ----------------------------------------------------
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        import ray_tpu
+
+        blocks = [b for b in self.materialize()._iter_blocks()]
+        whole = _block_concat(blocks)
+        n = _block_rows(whole)
+        per = max(1, (n + num_blocks - 1) // num_blocks)
+        refs = [ray_tpu.put(_block_slice(whole, i * per, min((i + 1) * per, n)))
+                for i in builtins.range(num_blocks) if i * per < n]
+        return Dataset(refs, [])
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        import ray_tpu
+
+        rng = np.random.default_rng(seed)
+        blocks = list(self.materialize()._iter_blocks())
+        whole = _block_concat(blocks)
+        n = _block_rows(whole)
+        perm = rng.permutation(n)
+        if isinstance(whole, dict):
+            shuffled: Block = {k: v[perm] for k, v in whole.items()}
+        else:
+            shuffled = [whole[i] for i in perm]
+        k = max(1, len(blocks))
+        per = (n + k - 1) // k
+        refs = [ray_tpu.put(_block_slice(shuffled, i * per,
+                                         min((i + 1) * per, n)))
+                for i in builtins.range(k) if i * per < n]
+        return Dataset(refs, [])
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Block-granularity split (ref: dataset.split)."""
+        parts: List[List[Any]] = [[] for _ in builtins.range(n)]
+        for i, ref in enumerate(self._block_refs):
+            parts[i % n].append(ref)
+        return [Dataset(p, list(self._ops)) for p in parts]
+
+    def streaming_split(self, n: int) -> List["DataIterator"]:
+        """Per-rank iterators for train ingest (ref:
+        stream_split_iterator.py)."""
+        parts: List[List[Any]] = [[] for _ in builtins.range(n)]
+        for i, ref in enumerate(self._block_refs):
+            parts[i % n].append(ref)
+        return [DataIterator(p, list(self._ops)) for p in parts]
+
+    def union(self, other: "Dataset") -> "Dataset":
+        if self._ops or other._ops:
+            a = self.materialize()
+            b = other.materialize()
+            return Dataset(a._block_refs + b._block_refs, [])
+        return Dataset(self._block_refs + other._block_refs, [])
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._block_refs)}, "
+                f"ops={[o[0] for o in self._ops]})")
+
+
+class DataIterator:
+    """Picklable per-rank iterator: holds block refs + pending ops and pulls
+    through the windowed executor in the consumer process
+    (ref: DataIterator, iterator.py; train ingest session.py:901)."""
+
+    def __init__(self, block_refs: List[Any], ops: List[tuple]):
+        self._block_refs = block_refs
+        self._ops = ops
+
+    def __reduce__(self):
+        return (DataIterator, (self._block_refs, self._ops))
+
+    def _dataset(self) -> Dataset:
+        return Dataset(self._block_refs, self._ops)
+
+    def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
+                     local_shuffle_seed: Optional[int] = None):
+        rng = (np.random.default_rng(local_shuffle_seed)
+               if local_shuffle_seed is not None else None)
+        buf: List[Block] = []
+        rows_in_buf = 0
+        for block in self._dataset()._iter_blocks():
+            buf.append(block)
+            rows_in_buf += _block_rows(block)
+            while rows_in_buf >= batch_size:
+                whole = _block_concat(buf)
+                if rng is not None:
+                    n = _block_rows(whole)
+                    perm = rng.permutation(n)
+                    if isinstance(whole, dict):
+                        whole = {k: v[perm] for k, v in whole.items()}
+                    else:
+                        whole = [whole[i] for i in perm]
+                batch = _block_slice(whole, 0, batch_size)
+                rest = _block_slice(whole, batch_size, _block_rows(whole))
+                buf = [rest]
+                rows_in_buf = _block_rows(rest)
+                yield batch
+        if rows_in_buf and not drop_last:
+            yield _block_concat(buf)
+
+    def iter_device_batches(self, *, batch_size: int, sharding=None,
+                            drop_last: bool = True):
+        """Double-buffered device feed: batch i+1 transfers to HBM while the
+        step consumes batch i (SURVEY.md §7.7 device-side prefetch)."""
+        import jax
+
+        def put(b):
+            if sharding is not None:
+                return jax.device_put(b, sharding)
+            return jax.device_put(b)
+
+        it = self.iter_batches(batch_size=batch_size, drop_last=drop_last)
+        prev = None
+        for batch in it:
+            cur = put(batch)
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
+
+
+# --- creation ---------------------------------------------------------------
+
+
+def _put_blocks(blocks: List[Block]) -> Dataset:
+    import ray_tpu
+
+    return Dataset([ray_tpu.put(b) for b in blocks], [])
+
+
+def range(n: int, *, num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    k = num_blocks or max(1, min(64, n // _DEFAULT_BLOCK_ROWS or 1))
+    per = (n + k - 1) // k
+    blocks = []
+    i = 0
+    while i * per < n:
+        blocks.append({"id": np.arange(i * per, min((i + 1) * per, n))})
+        i += 1
+    return _put_blocks(blocks)
+
+
+def from_items(items: Sequence[Any], *, num_blocks: int = 8) -> Dataset:
+    items = list(items)
+    k = max(1, min(num_blocks, len(items) or 1))
+    per = (len(items) + k - 1) // k
+    blocks = []
+    i = 0
+    while i * per < len(items):
+        blocks.append(items[i * per:(i + 1) * per])
+        i += 1
+    return _put_blocks([_rows_to_block(b) for b in blocks])
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, num_blocks: int = 8) -> Dataset:
+    n = len(next(iter(arrays.values())))
+    k = max(1, min(num_blocks, n))
+    per = (n + k - 1) // k
+    blocks = []
+    i = 0
+    while i * per < n:
+        blocks.append({key: v[i * per:(i + 1) * per]
+                       for key, v in arrays.items()})
+        i += 1
+    return _put_blocks(blocks)
+
+
+def from_pandas(df, *, num_blocks: int = 8) -> Dataset:
+    return from_numpy({c: df[c].to_numpy() for c in df.columns},
+                      num_blocks=num_blocks)
+
+
+def _read_files(paths, reader) -> Dataset:
+    import glob as _glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(_glob.glob(os.path.join(p, "*"))))
+        else:
+            files.extend(sorted(_glob.glob(p)) or [p])
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _read(path: str):
+        return reader(path)
+
+    return Dataset([_read.remote(f) for f in files], [])
+
+
+def read_parquet(paths) -> Dataset:
+    def reader(path):
+        import pyarrow.parquet as pq
+
+        t = pq.read_table(path)
+        return {c: t[c].to_numpy(zero_copy_only=False)
+                for c in t.column_names}
+
+    return _read_files(paths, reader)
+
+
+def read_csv(paths) -> Dataset:
+    def reader(path):
+        import pyarrow.csv as pc
+
+        t = pc.read_csv(path)
+        return {c: t[c].to_numpy(zero_copy_only=False)
+                for c in t.column_names}
+
+    return _read_files(paths, reader)
+
+
+def read_json(paths) -> Dataset:
+    def reader(path):
+        import pyarrow.json as pj
+
+        t = pj.read_json(path)
+        return {c: t[c].to_numpy(zero_copy_only=False)
+                for c in t.column_names}
+
+    return _read_files(paths, reader)

@@ -1,0 +1,97 @@
+"""ray_tpu.data: transforms, streaming iteration, split, file IO.
+
+Reference test model: python/ray/data/tests/.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(ray_start_regular):
+    ds = rd.range(1000, num_blocks=4)
+    assert ds.count() == 1000
+    rows = ds.take(5)
+    assert [int(r["id"]) for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_and_filter(ray_start_regular):
+    ds = (rd.range(100, num_blocks=4)
+          .map_batches(lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+          .filter(lambda r: r["id"] % 2 == 0))
+    rows = ds.take_all()
+    assert len(rows) == 50
+    assert all(int(r["sq"]) == int(r["id"]) ** 2 for r in rows)
+
+
+def test_from_items_map(ray_start_regular):
+    ds = rd.from_items([1, 2, 3, 4, 5], num_blocks=2).map(lambda x: x * 10)
+    assert sorted(ds.take_all()) == [10, 20, 30, 40, 50]
+
+
+def test_iter_batches_sizes(ray_start_regular):
+    ds = rd.range(250, num_blocks=5)
+    batches = list(ds.iter_batches(batch_size=64))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 250
+    assert all(s == 64 for s in sizes[:-1])
+
+
+def test_streaming_split_disjoint(ray_start_regular):
+    ds = rd.range(96, num_blocks=6)
+    its = ds.streaming_split(3)
+    seen = []
+    for it in its:
+        for b in it.iter_batches(batch_size=16):
+            seen.extend(int(x) for x in b["id"])
+    assert sorted(seen) == list(range(96))
+
+
+def test_random_shuffle_and_repartition(ray_start_regular):
+    ds = rd.range(100, num_blocks=4).random_shuffle(seed=7)
+    rows = [int(r["id"]) for r in ds.take_all()]
+    assert sorted(rows) == list(range(100))
+    assert rows != list(range(100))
+    ds2 = ds.repartition(10)
+    assert ds2.num_blocks() == 10
+    assert ds2.count() == 100
+
+
+def test_read_csv(ray_start_regular, tmp_path):
+    import pandas as pd
+
+    for i in range(3):
+        pd.DataFrame({"x": np.arange(10) + i * 10,
+                      "y": np.arange(10) * 2}).to_csv(
+            tmp_path / f"part{i}.csv", index=False)
+    ds = rd.read_csv(str(tmp_path))
+    assert ds.count() == 30
+    assert set(ds.schema()) == {"x", "y"}
+
+
+def test_trainer_dataset_ingest(ray_start_regular, tmp_path):
+    """Train ingest: get_dataset_shard inside the train loop."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ds = rd.range(64, num_blocks=4)
+
+    def loop(config):
+        from ray_tpu.train import session
+
+        it = session.get_dataset_shard("train")
+        total = 0
+        for b in it.iter_batches(batch_size=16):
+            total += int(b["id"].sum())
+        session.report({"total": total})
+        return total
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1, use_tpu=False),
+        run_config=RunConfig(name="ingest", storage_path=str(tmp_path)),
+        datasets={"train": ds}).fit()
+    assert result.ok, result.error
+    assert result.metrics["total"] == sum(range(64))
