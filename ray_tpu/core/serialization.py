@@ -83,7 +83,10 @@ def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
                     # functions/classes from user scripts the executing
                     # worker cannot import: embed by value
                     return (cloudpickle.loads, (_dumps_function(o),))
-            return NotImplemented
+            # chain to cloudpickle's own reducer_override (it handles
+            # __main__ functions/classes by value) — returning
+            # NotImplemented here would bypass it entirely
+            return super().reducer_override(o)
 
     import io
 

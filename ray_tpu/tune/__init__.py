@@ -1,0 +1,32 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Reference: python/ray/tune/ — Tuner.fit (tuner.py:320) → TuneController
+event loop (execution/tune_controller.py:49,267) over Trainable actors,
+search algorithms (search/basic_variant.py grid/random), trial schedulers
+(schedulers/async_hyperband.py ASHA), trial FSM (experiment/trial.py).
+
+    from ray_tpu import tune
+
+    def objective(config):
+        for step in range(10):
+            tune.report({"score": step * config["lr"]})
+
+    results = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.1, 0.01])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    best = results.get_best_result()
+"""
+
+from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
+                                 uniform)
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
+                                report, get_trial_context)
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
+    "get_trial_context", "grid_search", "choice", "uniform", "loguniform",
+    "randint", "ASHAScheduler", "FIFOScheduler",
+]

@@ -1,0 +1,59 @@
+"""Trial schedulers.
+
+Reference: python/ray/tune/schedulers/ — FIFOScheduler (no-op) and ASHA
+(async_hyperband.py): asynchronous successive halving on reported metrics;
+a trial that falls below the rung's top-1/reduction_factor quantile at a
+milestone is stopped.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung milestones: grace * rf^k up to max_t
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        self.rungs: Dict[int, Dict[str, float]] = defaultdict(dict)
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        val = float(metric) if self.mode == "max" else -float(metric)
+        decision = CONTINUE
+        for ms in self.milestones:
+            if t >= ms and trial_id not in self.rungs[ms]:
+                self.rungs[ms][trial_id] = val
+                peers = sorted(self.rungs[ms].values(), reverse=True)
+                k = max(1, len(peers) // self.rf)
+                cutoff = peers[k - 1]
+                if val < cutoff and len(peers) >= self.rf:
+                    decision = STOP
+        return decision

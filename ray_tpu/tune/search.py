@@ -1,0 +1,100 @@
+"""Search space + variant generation.
+
+Reference: python/ray/tune/search/{sample.py,basic_variant.py} — grid_search
+cross products with random sampling for distribution params.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+class Sampler:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Choice(Sampler):
+    def __init__(self, values):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+class Uniform(Sampler):
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def sample(self, rng):
+        return rng.uniform(self.lo, self.hi)
+
+
+class LogUniform(Sampler):
+    def __init__(self, lo, hi):
+        import math
+
+        self.lo, self.hi = math.log(lo), math.log(hi)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class RandInt(Sampler):
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def sample(self, rng):
+        return rng.randrange(self.lo, self.hi)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(values) -> Choice:
+    return Choice(values)
+
+
+def uniform(lo, hi) -> Uniform:
+    return Uniform(lo, hi)
+
+
+def loguniform(lo, hi) -> LogUniform:
+    return LogUniform(lo, hi)
+
+
+def randint(lo, hi) -> RandInt:
+    return RandInt(lo, hi)
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: int = 0) -> List[Dict[str, Any]]:
+    """Grid params cross-product; sampler params drawn per sample
+    (ref: basic_variant.py — num_samples repeats the grid)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+    grids = [param_space[k].values for k in grid_keys]
+    combos = list(itertools.product(*grids)) if grid_keys else [()]
+    variants = []
+    for _ in range(max(1, num_samples)):
+        for combo in combos:
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Sampler):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

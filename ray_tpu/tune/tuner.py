@@ -1,0 +1,268 @@
+"""Tuner + trial controller.
+
+Reference: tune/tuner.py:320 Tuner.fit → execution/tune_controller.py event
+loop (step:267, actor scheduling :596): trials run as actors; the controller
+polls reported results, feeds the scheduler, stops losers, and starts queued
+trials as resources free up. Experiment state is snapshotted to the run dir
+(ref: tune/execution/experiment_state.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_variants
+
+
+# ---- in-trial reporting API -------------------------------------------------
+
+class _TrialContext:
+    def __init__(self, trial_id: str, config: dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.reports: List[dict] = []
+        self.lock = threading.Lock()
+        self.iteration = 0
+        self.stop_requested = False
+
+
+_trial_ctx: Optional[_TrialContext] = None
+
+
+def _set_trial_ctx(ctx: Optional[_TrialContext]) -> None:
+    # NOTE: must be a module function called by reference. The @remote actor
+    # class below ships to workers pickled BY VALUE (the module attribute is
+    # the ActorClass wrapper, so cloudpickle cannot pickle the raw class by
+    # reference), which gives its methods a COPY of these globals — a bare
+    # `global` assignment inside a method would write to the copy while
+    # tune.report reads the real module.
+    global _trial_ctx
+    _trial_ctx = ctx
+
+
+def get_trial_context() -> Optional[_TrialContext]:
+    return _trial_ctx
+
+
+class TrialStopped(Exception):
+    """Raised inside a trial when the scheduler has stopped it."""
+
+
+def report(metrics: Dict[str, Any]) -> None:
+    """ref: tune report / session.report — also the scheduler's stop
+    injection point: raises TrialStopped if the controller killed us."""
+    ctx = _trial_ctx
+    if ctx is None:
+        raise RuntimeError("tune.report called outside a trial")
+    ctx.iteration += 1
+    entry = dict(metrics)
+    entry.setdefault("training_iteration", ctx.iteration)
+    entry["_ts"] = time.time()
+    with ctx.lock:
+        ctx.reports.append(entry)
+    if ctx.stop_requested:
+        raise TrialStopped()
+
+
+@ray_tpu.remote
+class _TrialActor:
+    def __init__(self, trial_id: str, config: dict):
+        self.ctx = _TrialContext(trial_id, config)
+        self.error: Optional[str] = None
+        self.done = False
+        self.final: Any = None
+
+    def run(self, fn: Callable) -> Any:
+        _set_trial_ctx(self.ctx)
+        try:
+            self.final = fn(self.ctx.config)
+            if isinstance(self.final, dict):
+                with self.ctx.lock:
+                    entry = dict(self.final)
+                    entry.setdefault("training_iteration",
+                                     self.ctx.iteration + 1)
+                    self.ctx.reports.append(entry)
+            return self.final
+        except TrialStopped:
+            return None
+        except BaseException:
+            import traceback
+
+            self.error = traceback.format_exc()
+            raise
+        finally:
+            self.done = True
+
+    def poll(self, after: int) -> dict:
+        with self.ctx.lock:
+            new = self.ctx.reports[after:]
+        return {"reports": new, "done": self.done, "error": self.error}
+
+    def request_stop(self):
+        self.ctx.stop_requested = True
+        return True
+
+
+# ---- results ----------------------------------------------------------------
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    metrics_history: List[dict] = field(default_factory=list)
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self):
+        return len(self._results)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric configured")
+
+        def last_value(r: TrialResult):
+            if metric in r.metrics:
+                return r.metrics[metric]
+            for entry in reversed(r.metrics_history):
+                if metric in entry:
+                    return entry[metric]
+            return None
+
+        valid = [(r, last_value(r)) for r in self._results]
+        valid = [(r, v) for r, v in valid if v is not None]
+        if not valid:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        best = (max if mode == "max" else min)(valid, key=lambda rv: rv[1])
+        return best[0]
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([{**r.config, **r.metrics,
+                              "trial_id": r.trial_id} for r in self._results])
+
+    @property
+    def errors(self) -> List[TrialResult]:
+        return [r for r in self._results if r.error]
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    seed: int = 0
+    resources_per_trial: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        if getattr(scheduler, "metric", None) is None and hasattr(scheduler, "metric"):
+            scheduler.metric = tc.metric
+        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
+        max_conc = tc.max_concurrent_trials or len(variants)
+
+        pending = [(f"trial_{i:05d}", cfg) for i, cfg in enumerate(variants)]
+        running: Dict[str, dict] = {}
+        results: Dict[str, TrialResult] = {}
+
+        def launch(trial_id: str, cfg: dict):
+            actor = _TrialActor.options(
+                resources=dict(tc.resources_per_trial),
+                max_concurrency=2).remote(trial_id, cfg)
+            run_ref = actor.run.remote(self.trainable)
+            running[trial_id] = {"actor": actor, "run_ref": run_ref,
+                                 "seen": 0,
+                                 "result": TrialResult(trial_id, cfg)}
+
+        # ---- controller loop (ref: tune_controller.step:267) ----
+        while pending or running:
+            while pending and len(running) < max_conc:
+                tid, cfg = pending.pop(0)
+                launch(tid, cfg)
+            time.sleep(0.05)
+            for tid in list(running):
+                st = running[tid]
+                try:
+                    poll = ray_tpu.get(st["actor"].poll.remote(st["seen"]),
+                                       timeout=30)
+                except Exception as e:
+                    res = st["result"]
+                    res.error = f"trial actor lost: {e}"
+                    results[tid] = res
+                    del running[tid]
+                    continue
+                res = st["result"]
+                for r in poll["reports"]:
+                    res.metrics_history.append(r)
+                    res.metrics = r
+                    decision = scheduler.on_result(tid, r)
+                    if decision == STOP and not poll["done"]:
+                        try:
+                            st["actor"].request_stop.remote()
+                        except Exception:
+                            pass
+                        res.stopped_early = True
+                st["seen"] += len(poll["reports"])
+                if poll["done"]:
+                    if poll["error"] and "TrialStopped" not in poll["error"]:
+                        res.error = poll["error"]
+                    results[tid] = res
+                    try:
+                        ray_tpu.kill(st["actor"])
+                    except Exception:
+                        pass
+                    del running[tid]
+        ordered = [results[tid] for tid in sorted(results)]
+        self._save_experiment_state(ordered)
+        return ResultGrid(ordered, tc.metric, tc.mode)
+
+    def _save_experiment_state(self, results: List[TrialResult]):
+        run_dir = None
+        if self.run_config is not None:
+            base = getattr(self.run_config, "storage_path", None)
+            name = getattr(self.run_config, "name", None)
+            if base and name:
+                run_dir = os.path.join(base, name)
+        if run_dir is None:
+            return
+        os.makedirs(run_dir, exist_ok=True)
+        state = [{"trial_id": r.trial_id, "config": r.config,
+                  "metrics": r.metrics, "error": r.error,
+                  "stopped_early": r.stopped_early} for r in results]
+        with open(os.path.join(run_dir, "experiment_state.json"), "w") as f:
+            json.dump(state, f, indent=2, default=str)

@@ -1,0 +1,74 @@
+"""Tune: grid/random search, ASHA early stopping, best-result selection."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+def test_grid_search_best(ray_start_regular, tmp_path):
+    def objective(config):
+        return {"score": -(config["x"] - 3) ** 2}
+
+    results = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4, 5])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=3),
+    ).fit()
+    assert len(results) == 6
+    assert results.get_best_result().config["x"] == 3
+
+
+def test_random_sampling(ray_start_regular):
+    def objective(config):
+        return {"val": config["lr"]}
+
+    results = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(metric="val", mode="min", num_samples=4,
+                                    max_concurrent_trials=2),
+    ).fit()
+    assert len(results) == 4
+    for r in results:
+        assert 1e-4 <= r.metrics["val"] <= 1e-1
+
+
+def test_intermediate_reports_and_asha(ray_start_regular):
+    def objective(config):
+        import time
+
+        for i in range(20):
+            tune.report({"loss": 100.0 / config["q"] - i})
+            time.sleep(0.01)
+        return {"final": True}
+
+    sched = tune.ASHAScheduler(metric="loss", mode="min", max_t=20,
+                               grace_period=2, reduction_factor=2)
+    results = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1, 2, 4, 8])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    scheduler=sched,
+                                    max_concurrent_trials=4),
+    ).fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["q"] == 8
+    stopped = [r for r in results if r.stopped_early]
+    assert stopped, "ASHA should stop at least one losing trial"
+
+
+def test_trial_error_isolated(ray_start_regular):
+    def objective(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        return {"ok": 1}
+
+    results = tune.Tuner(
+        objective, param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+    ).fit()
+    assert len(results.errors) == 1
+    assert results.get_best_result().metrics["ok"] == 1
