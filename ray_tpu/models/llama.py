@@ -183,8 +183,9 @@ def _attention(q, k, v, cfg: LlamaConfig, causal=True, q_offset=0):
     return _attention_xla(q, k, v, causal, q_offset)
 
 
-def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None):
-    """One transformer block. x: [B, S, D]. cache: (k, v, offset) or None."""
+def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None, collect_kv=False):
+    """One transformer block. x: [B, S, D]. cache: (k, v, offset) or None.
+    collect_kv=True returns this layer's (k, v) for cache seeding."""
     B, S, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -216,6 +217,8 @@ def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None):
     gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
     up = h @ lp["w_up"].astype(dt)
     x = x + (gate * up) @ lp["w_down"].astype(dt)
+    if collect_kv:
+        return x, (k, v)
     return x, new_cache
 
 
@@ -347,6 +350,100 @@ def cache_specs(cfg: LlamaConfig):
     return KVCache(("layers", None, None, "kv_heads", "head_dim"),
                    ("layers", None, None, "kv_heads", "head_dim"),
                    (None,))
+
+
+def prefill(params, tokens, lengths, cfg: LlamaConfig):
+    """Batched prefill for the continuous-batching engine. tokens [n, P]
+    right-padded; lengths [n] true lengths. Returns (logits_at_last [n, V],
+    k_layers [L, n, P, KV, HD], v_layers). Pad positions produce garbage
+    k/v but are never attended later (decode masks kpos < length and new
+    tokens overwrite pad slots)."""
+    dt = cfg.dtype
+    B, P = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    cos, sin = _rope_tables(cfg.rope_theta, P, cfg.head_dim)
+
+    def body(x, lp):
+        y, kv = _layer(x, lp, cfg, cos, sin, collect_kv=True)
+        return y, kv
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # logits at each row's final REAL position
+    idx = jnp.clip(lengths - 1, 0, P - 1)
+    last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+    logits = last @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32), ks, vs
+
+
+def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
+                active=None) -> Tuple[jax.Array, KVCache]:
+    """One continuous-batching decode step with PER-ROW positions.
+    tokens [B, 1]; cache.length [B] gives each row's write position; rows
+    where active==0 keep their cache untouched. Returns (logits [B, V],
+    updated cache)."""
+    dt = cfg.dtype
+    B = tokens.shape[0]
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos = cache.length                                    # [B]
+    if active is None:
+        active = jnp.ones((B,), jnp.int32)
+
+    cos_full, sin_full = _rope_tables(cfg.rope_theta, cfg.max_seq_len,
+                                      cfg.head_dim)
+    cos = cos_full[pos][:, None, :]                       # [B, 1, HD/2]
+    sin = sin_full[pos][:, None, :]
+
+    def rope1(x):  # x: [B, 1, N, HD] with per-row tables
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                               axis=-1).astype(x.dtype)
+
+    x = params["embed"].astype(dt)[tokens]                # [B, 1, D]
+    S = cache.k.shape[2]
+    kpos = jnp.arange(S)[None, :]                         # [1, S]
+    attn_mask = (kpos <= pos[:, None]) & (active[:, None] > 0)  # [B, S]
+
+    def body(x, inp):
+        lp, ck, cv = inp                                   # ck: [B, S, KV, HD]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = rope1((h @ lp["wq"].astype(dt)).reshape(B, 1, H, HD))
+        k = rope1((h @ lp["wk"].astype(dt)).reshape(B, 1, KV, HD))
+        v = (h @ lp["wv"].astype(dt)).reshape(B, 1, KV, HD)
+        upd = jax.vmap(
+            lambda c, kk, p, a: jax.lax.cond(
+                a > 0,
+                lambda: jax.lax.dynamic_update_slice(c, kk, (p, 0, 0)),
+                lambda: c))(ck, k.astype(ck.dtype)[:, 0][:, None], pos, active)
+        vpd = jax.vmap(
+            lambda c, kk, p, a: jax.lax.cond(
+                a > 0,
+                lambda: jax.lax.dynamic_update_slice(c, kk, (p, 0, 0)),
+                lambda: c))(cv, v.astype(cv.dtype)[:, 0][:, None], pos, active)
+        kk = upd.astype(dt)                                # [B, S, KV, HD]
+        vv = vpd.astype(dt)
+        # scores: q [B,1,H,HD] x kk [B,S,KV,HD], GQA groups
+        G = H // KV
+        q5 = q.reshape(B, 1, KV, G, HD)
+        s = jnp.einsum("bqkgd,bskd->bkgqs", q5, kk,
+                       preferred_element_type=jnp.float32) / (HD ** 0.5)
+        s = jnp.where(attn_mask[:, None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        o = jnp.einsum("bkgqs,bskd->bqkgd", p, vv).reshape(B, 1, H * HD)
+        x = x + o @ lp["wo"].astype(dt)
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (upd, vpd)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    new_len = cache.length + active
+    return logits, KVCache(nk, nv, new_len)
 
 
 def forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig,

@@ -1,0 +1,32 @@
+"""ray_tpu.serve: model serving on actor replicas.
+
+Reference: python/ray/serve/ — @serve.deployment + serve.run (api.py:242,414)
+→ detached ServeController actor (controller.py:74) reconciling replica
+actors (deployment_state.py:1097), client-side Router with
+power-of-two-choices (router.py:262), @serve.batch dynamic batching
+(batching.py:65), queue-depth autoscaling (autoscaling_policy.py).
+
+TPU-first addition: ray_tpu.serve.llm — a continuous-batching LLM replica
+(static-shape decode slots + bucketed prefill over the KV cache in HBM),
+the design the reference lacks natively (SURVEY.md §7.9).
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Model.bind())
+    ref = handle.remote(21)
+"""
+
+from ray_tpu.serve.api import (Application, Deployment, deployment,
+                               get_deployment_handle, run, shutdown)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "deployment", "run", "shutdown", "get_deployment_handle", "batch",
+    "Deployment", "Application", "DeploymentHandle",
+]

@@ -1,0 +1,119 @@
+"""serve public API: @deployment, run, shutdown, handles.
+
+Reference: python/ray/serve/api.py:242 (@serve.deployment), :414 (serve.run).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+CONTROLLER_NAME = "_serve_controller"
+_NAMESPACE = "serve"
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    user_config: Any = None
+    autoscaling_config: Optional[dict] = None
+    ray_actor_options: Optional[dict] = None
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        d = Deployment(self.func_or_class, self.name, self.num_replicas,
+                       self.max_concurrent_queries, self.user_config,
+                       self.autoscaling_config, self.ray_actor_options,
+                       args, kwargs)
+        return Application([d], d)
+
+    def options(self, **kw) -> "Deployment":
+        d = Deployment(self.func_or_class, kw.pop("name", self.name),
+                       kw.pop("num_replicas", self.num_replicas),
+                       kw.pop("max_concurrent_queries",
+                              self.max_concurrent_queries),
+                       kw.pop("user_config", self.user_config),
+                       kw.pop("autoscaling_config", self.autoscaling_config),
+                       kw.pop("ray_actor_options", self.ray_actor_options))
+        if kw:
+            raise ValueError(f"unknown deployment options {sorted(kw)}")
+        return d
+
+
+@dataclass
+class Application:
+    deployments: List[Deployment]
+    ingress: Deployment
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 100,
+               user_config: Any = None,
+               autoscaling_config: Optional[dict] = None,
+               ray_actor_options: Optional[dict] = None):
+    def deco(obj):
+        return Deployment(obj, name or getattr(obj, "__name__", "deployment"),
+                          num_replicas, max_concurrent_queries, user_config,
+                          autoscaling_config, ray_actor_options)
+
+    if _func_or_class is not None:
+        return deco(_func_or_class)
+    return deco
+
+
+def _get_or_start_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+    except ValueError:
+        from ray_tpu.serve.controller import ServeController
+
+        try:
+            return ServeController.options(
+                name=CONTROLLER_NAME, namespace=_NAMESPACE,
+                max_concurrency=16).remote()
+        except ValueError:
+            return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+
+
+def run(app: Application, *, _blocking: bool = False) -> DeploymentHandle:
+    """Deploy every deployment in the app; returns the ingress handle
+    (ref: serve.run api.py:414)."""
+    controller = _get_or_start_controller()
+    for d in app.deployments:
+        from ray_tpu.core.runtime import _dumps_function
+
+        blob = _dumps_function(d.func_or_class) \
+            if callable(d.func_or_class) else cloudpickle.dumps(d.func_or_class)
+        config = {
+            "num_replicas": d.num_replicas,
+            "max_concurrent_queries": d.max_concurrent_queries,
+            "user_config": d.user_config,
+            "autoscaling_config": d.autoscaling_config,
+            "ray_actor_options": d.ray_actor_options,
+        }
+        ray_tpu.get(controller.deploy.remote(
+            d.name, blob, d.init_args, d.init_kwargs, config))
+    return DeploymentHandle(app.ingress.name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+    except ValueError:
+        return
+    for name in ray_tpu.get(controller.list_deployments.remote()):
+        ray_tpu.get(controller.delete_deployment.remote(name))
+    ray_tpu.kill(controller)

@@ -1,0 +1,192 @@
+"""ServeController + Replica actors.
+
+Reference: python/ray/serve/controller.py:74 (checkpointed controller state
+machine), _private/deployment_state.py:1097 (replica FSM, rolling updates,
+_scale_deployment_replicas:1537), _private/replica.py, autoscaling on
+replica queue metrics (_private/autoscaling_policy.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Replica:
+    """Wraps one instance of the user's deployment callable. Requests enter
+    via handle_request; an async-capable wrapper lets @serve.batch and
+    async __call__ work; queue depth is tracked for autoscaling."""
+
+    def __init__(self, import_blob: bytes, init_args, init_kwargs,
+                 user_config=None):
+        import cloudpickle
+
+        cls_or_fn = cloudpickle.loads(import_blob)
+        if isinstance(cls_or_fn, type):
+            self.instance = cls_or_fn(*init_args, **(init_kwargs or {}))
+        else:
+            self.instance = cls_or_fn
+        self.inflight = 0
+        if user_config is not None and hasattr(self.instance,
+                                               "reconfigure"):
+            self.instance.reconfigure(user_config)
+
+    async def handle_request(self, method: str, args, kwargs):
+        self.inflight += 1
+        try:
+            fn = getattr(self.instance, method)
+            out = fn(*args, **kwargs)
+            import asyncio
+
+            if asyncio.iscoroutine(out):
+                out = await out
+            return out
+        finally:
+            self.inflight -= 1
+
+    def queue_len(self) -> int:
+        return self.inflight
+
+    def reconfigure(self, user_config):
+        if hasattr(self.instance, "reconfigure"):
+            self.instance.reconfigure(user_config)
+        return True
+
+
+@ray_tpu.remote
+class ServeController:
+    """Deployment table + reconcile/autoscale thread
+    (ref: controller.py run_control_loop)."""
+
+    def __init__(self):
+        self.deployments: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread = threading.Thread(target=self._control_loop, daemon=True)
+        self._thread.start()
+
+    # ---- API ----------------------------------------------------------------
+
+    def deploy(self, name: str, import_blob: bytes, init_args, init_kwargs,
+               config: dict) -> bool:
+        with self._lock:
+            old = self.deployments.get(name)
+            self.deployments[name] = {
+                "blob": import_blob, "args": init_args,
+                "kwargs": init_kwargs or {}, "config": dict(config),
+                "replicas": old["replicas"] if old else [],
+                "version": (old["version"] + 1) if old else 0,
+            }
+        self._reconcile(name, rolling=old is not None)
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            d = self.deployments.pop(name, None)
+        if d:
+            for r in d["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True
+
+    def get_replicas(self, name: str) -> List[Any]:
+        d = self.deployments.get(name)
+        return list(d["replicas"]) if d else []
+
+    def list_deployments(self) -> Dict[str, dict]:
+        out = {}
+        for name, d in self.deployments.items():
+            out[name] = {"num_replicas": len(d["replicas"]),
+                         "config": d["config"], "version": d["version"]}
+        return out
+
+    def ping(self) -> str:
+        return "pong"
+
+    # ---- reconcile ----------------------------------------------------------
+
+    def _make_replica(self, d: dict):
+        cfg = d["config"]
+        opts = {"max_concurrency": cfg.get("max_concurrent_queries", 100)}
+        if cfg.get("ray_actor_options"):
+            opts.update(cfg["ray_actor_options"])
+        return Replica.options(**opts).remote(
+            d["blob"], d["args"], d["kwargs"], cfg.get("user_config"))
+
+    def _reconcile(self, name: str, rolling: bool = False):
+        with self._lock:
+            d = self.deployments.get(name)
+            if d is None:
+                return
+            target = int(d["config"].get("num_replicas", 1))
+            replicas = d["replicas"]
+        if rolling:
+            # rolling update: replace one at a time (ref:
+            # deployment_state.py rolling update path)
+            new = []
+            for r in replicas:
+                nr = self._make_replica(d)
+                ray_tpu.get(nr.queue_len.remote())     # wait ready
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+                new.append(nr)
+            replicas = new
+        while len(replicas) < target:
+            replicas.append(self._make_replica(d))
+        while len(replicas) > target:
+            r = replicas.pop()
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        with self._lock:
+            if name in self.deployments:
+                self.deployments[name]["replicas"] = replicas
+
+    def _control_loop(self):
+        """Autoscaling on queue depth (ref: autoscaling_policy.py — target
+        ongoing requests per replica) + dead-replica replacement."""
+        while not self._stop:
+            time.sleep(1.0)
+            for name in list(self.deployments):
+                d = self.deployments.get(name)
+                if d is None:
+                    continue
+                auto = d["config"].get("autoscaling_config")
+                # replace dead replicas
+                alive = []
+                for r in d["replicas"]:
+                    try:
+                        ray_tpu.get(r.queue_len.remote(), timeout=5)
+                        alive.append(r)
+                    except Exception:
+                        pass
+                if len(alive) != len(d["replicas"]):
+                    with self._lock:
+                        d["replicas"] = alive
+                    self._reconcile(name)
+                    continue
+                if not auto:
+                    continue
+                try:
+                    qs = ray_tpu.get([r.queue_len.remote()
+                                      for r in d["replicas"]], timeout=5)
+                except Exception:
+                    continue
+                total = sum(qs)
+                per = auto.get("target_num_ongoing_requests_per_replica", 2)
+                want = max(auto.get("min_replicas", 1),
+                           min(auto.get("max_replicas", 4),
+                               (total + per - 1) // per or 1))
+                if want != len(d["replicas"]):
+                    with self._lock:
+                        d["config"]["num_replicas"] = want
+                    self._reconcile(name)

@@ -1,0 +1,107 @@
+"""DeploymentHandle + Router: client-side replica scheduling.
+
+Reference: python/ray/serve/handle.py:86 (RayServeHandle) and
+_private/router.py:262 (PowerOfTwoChoicesReplicaScheduler). The router keeps
+a local in-flight counter per replica and picks the less-loaded of two
+random candidates — queue-length routing without extra RPCs (the reference
+gets queue lengths pushed via long-poll; local counters approximate it).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, deployment_name: str, controller_name: str = "_serve_controller"):
+        self.deployment_name = deployment_name
+        self.controller_name = controller_name
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False):
+        now = time.time()
+        if not force and self._replicas and now - self._last_refresh < 5.0:
+            return
+        controller = ray_tpu.get_actor(self.controller_name, namespace="serve")
+        replicas = ray_tpu.get(
+            controller.get_replicas.remote(self.deployment_name))
+        with self._lock:
+            self._replicas = replicas
+            self._inflight = {i: self._inflight.get(i, 0)
+                              for i in range(len(replicas))}
+            self._last_refresh = now
+
+    def pick(self) -> tuple:
+        self._refresh()
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"no replicas for deployment {self.deployment_name!r}")
+            if n == 1:
+                i = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                i = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+            self._inflight[i] = self._inflight.get(i, 0) + 1
+            return i, self._replicas[i]
+
+    def done(self, idx: int):
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+
+class DeploymentHandle:
+    """Serializable; rebuilds its router lazily in the holding process."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._router: Optional[Router] = None
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            self._router = Router(self.deployment_name)
+        return self._router
+
+    def remote(self, *args, **kwargs):
+        return self._call("__call__", args, kwargs)
+
+    def method(self, name: str):
+        handle = self
+
+        class _M:
+            def remote(self, *args, **kwargs):
+                return handle._call(name, args, kwargs)
+
+        return _M()
+
+    def _call(self, method: str, args, kwargs):
+        router = self._get_router()
+        for attempt in range(3):
+            idx, replica = router.pick()
+            try:
+                ref = getattr(replica, "handle_request").remote(
+                    method, args, kwargs)
+                router.done(idx)
+                return ref
+            except (ray_tpu.exceptions.ActorDiedError,
+                    ray_tpu.exceptions.ActorUnavailableError):
+                router.done(idx)
+                router._refresh(force=True)
+        raise RuntimeError(
+            f"could not reach a replica of {self.deployment_name}")
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.deployment_name!r})"

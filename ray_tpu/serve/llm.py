@@ -1,0 +1,255 @@
+"""Continuous-batching LLM engine + serve deployment.
+
+The vLLM-capability analog for TPU (BASELINE.md config 4: continuous-batched
+llama serving; SURVEY.md §7.9). The reference has no native LLM engine — its
+serve layer delegates to user code. TPU-first design constraints drive the
+shape of this engine (SURVEY.md §7 hard parts: "static-shape XLA vs dynamic
+batch composition; bucketed compilation"):
+
+- a fixed pool of decode SLOTS: the decode step is one jitted program of
+  static shape [max_slots] regardless of how many requests are active
+  (inactive rows are masked) — no recompilation as requests come and go.
+- bucketed prefill: prompts are right-padded to a power-of-two bucket, so
+  XLA compiles one prefill program per bucket size; per-row true lengths
+  keep attention exact (pad slots are never attended).
+- admission: new requests prefill into free slots between decode steps —
+  continuous batching, not static batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class _Request:
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    slot: int = -1
+    generated: List[int] = field(default_factory=list)
+    done_event: threading.Event = field(default_factory=threading.Event)
+    submit_time: float = field(default_factory=time.time)
+    first_token_time: Optional[float] = None
+
+
+class LLMEngine:
+    """Synchronous engine core; drive with step(). Thread-safe submit."""
+
+    def __init__(self, cfg=None, params=None, *, preset: str = "tiny",
+                 max_slots: int = 8, max_seq_len: Optional[int] = None,
+                 eos_token: int = -1, seed: int = 0, mesh=None, rules=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+
+        self._jax = jax
+        self._jnp = jnp
+        self._llama = llama
+        if cfg is None:
+            cfg = llama.PRESETS[preset]
+            if jax.default_backend() != "tpu":
+                cfg = cfg.replace(dtype=jnp.float32)
+        self.cfg = cfg
+        self.max_seq = max_seq_len or cfg.max_seq_len
+        self.max_slots = max_slots
+        self.eos = eos_token
+        if params is None:
+            params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+        if mesh is not None and rules is not None:
+            from ray_tpu.parallel.sharding import shard_params
+
+            params = shard_params(mesh, params, llama.param_specs(cfg), rules)
+        self.params = params
+        self.cache = llama.init_cache(cfg, max_slots, max_seq=self.max_seq)
+        self.slots: List[Optional[_Request]] = [None] * max_slots
+        self.lock = threading.Lock()
+        self.pending: List[_Request] = []
+        self._next_id = 0
+        self._last_tokens = np.zeros((max_slots, 1), np.int32)
+
+        self._decode = jax.jit(
+            lambda p, t, c, a: llama.decode_step(p, t, c, cfg, active=a))
+        self._prefill = jax.jit(
+            lambda p, t, l: llama.prefill(p, t, l, cfg))  # noqa: E741
+
+        self.metrics = {"requests": 0, "tokens_generated": 0,
+                        "ttft_sum": 0.0, "ttft_count": 0}
+
+    # ---- submission --------------------------------------------------------
+
+    def submit(self, prompt: List[int], max_new_tokens: int = 32,
+               temperature: float = 0.0) -> _Request:
+        with self.lock:
+            req = _Request(self._next_id, list(prompt), max_new_tokens,
+                           temperature)
+            self._next_id += 1
+            self.pending.append(req)
+            self.metrics["requests"] += 1
+        return req
+
+    def has_work(self) -> bool:
+        with self.lock:
+            return bool(self.pending) or any(s is not None for s in self.slots)
+
+    # ---- engine step -------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return min(b, self.max_seq)
+
+    def _admit(self):
+        import jax.numpy as jnp
+
+        with self.lock:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            admit = self.pending[:len(free)]
+            self.pending = self.pending[len(admit):]
+            for req, slot in zip(admit, free):
+                req.slot = slot
+                self.slots[slot] = req
+        if not admit:
+            return
+        P = self._bucket(max(len(r.prompt) for r in admit))
+        toks = np.zeros((len(admit), P), np.int32)
+        lens = np.zeros((len(admit),), np.int32)
+        for i, r in enumerate(admit):
+            p = r.prompt[-P:]
+            toks[i, :len(p)] = p
+            lens[i] = len(p)
+        logits, ks, vs = self._prefill(self.params, jnp.asarray(toks),
+                                       jnp.asarray(lens))
+        # scatter new kv into cache slots + set lengths
+        slots = jnp.asarray([r.slot for r in admit])
+        k = self.cache.k.at[:, slots, :P].set(ks.astype(self.cache.k.dtype))
+        v = self.cache.v.at[:, slots, :P].set(vs.astype(self.cache.v.dtype))
+        length = self.cache.length.at[slots].set(jnp.asarray(lens))
+        from ray_tpu.models.llama import KVCache
+
+        self.cache = KVCache(k, v, length)
+        first = np.asarray(self._sample(logits, [r.temperature for r in admit]))
+        now = time.time()
+        for i, r in enumerate(admit):
+            tok = int(first[i])
+            r.generated.append(tok)
+            r.first_token_time = now
+            self.metrics["ttft_sum"] += now - r.submit_time
+            self.metrics["ttft_count"] += 1
+            self.metrics["tokens_generated"] += 1
+            self._last_tokens[r.slot, 0] = tok
+            self._maybe_finish(r)
+
+    def _sample(self, logits, temps):
+        import jax
+
+        jnp = self._jnp
+        logits = jnp.asarray(logits)
+        greedy = jnp.argmax(logits, axis=-1)
+        if all(t == 0.0 for t in temps):
+            return greedy
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        t = jnp.asarray([max(tt, 1e-4) for tt in temps])[:, None]
+        sampled = jax.random.categorical(key, logits / t, axis=-1)
+        use_greedy = jnp.asarray([tt == 0.0 for tt in temps])
+        return jnp.where(use_greedy, greedy, sampled)
+
+    def _maybe_finish(self, r: _Request):
+        if (len(r.generated) >= r.max_new_tokens
+                or (self.eos >= 0 and r.generated
+                    and r.generated[-1] == self.eos)
+                or len(r.prompt) + len(r.generated) >= self.max_seq - 1):
+            with self.lock:
+                if r.slot >= 0:
+                    self.slots[r.slot] = None
+                    r.slot = -1
+            r.done_event.set()
+
+    def step(self) -> int:
+        """Admit + one decode step for all active slots. Returns number of
+        active requests after the step."""
+        import jax.numpy as jnp
+
+        self._admit()
+        with self.lock:
+            active_reqs = [r for r in self.slots if r is not None]
+            active_mask = np.array(
+                [1 if s is not None else 0 for s in self.slots], np.int32)
+        if not active_reqs:
+            return 0
+        logits, self.cache = self._decode(
+            self.params, jnp.asarray(self._last_tokens), self.cache,
+            jnp.asarray(active_mask))
+        temps = [0.0] * self.max_slots
+        with self.lock:
+            for r in self.slots:
+                if r is not None:
+                    temps[r.slot] = r.temperature
+        toks = np.asarray(self._sample(logits, temps))
+        for r in list(active_reqs):
+            if r.slot < 0:
+                continue
+            tok = int(toks[r.slot])
+            r.generated.append(tok)
+            self.metrics["tokens_generated"] += 1
+            self._last_tokens[r.slot, 0] = tok
+            self._maybe_finish(r)
+        with self.lock:
+            return sum(1 for s in self.slots if s is not None)
+
+    def generate(self, prompt: List[int], max_new_tokens: int = 32,
+                 temperature: float = 0.0) -> List[int]:
+        """Synchronous convenience: submit + drive until done."""
+        req = self.submit(prompt, max_new_tokens, temperature)
+        while not req.done_event.is_set():
+            self.step()
+        return req.generated
+
+
+class LLMServer:
+    """Serve deployment hosting an engine; a background thread drives the
+    decode loop so concurrent requests batch continuously."""
+
+    def __init__(self, preset: str = "tiny", max_slots: int = 8,
+                 eos_token: int = -1, params=None, cfg=None, **kw):
+        self.engine = LLMEngine(cfg=cfg, params=params, preset=preset,
+                                max_slots=max_slots, eos_token=eos_token, **kw)
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            if self.engine.has_work():
+                self.engine.step()
+            else:
+                self._wake.wait(timeout=0.01)
+                self._wake.clear()
+
+    async def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = list(request["prompt"])
+        req = self.engine.submit(prompt,
+                                 int(request.get("max_new_tokens", 32)),
+                                 float(request.get("temperature", 0.0)))
+        self._wake.set()
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, req.done_event.wait)
+        ttft = (req.first_token_time - req.submit_time
+                if req.first_token_time else None)
+        return {"tokens": req.generated, "ttft_s": ttft}
+
+    def stats(self) -> Dict[str, Any]:
+        m = dict(self.engine.metrics)
+        if m["ttft_count"]:
+            m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
+        return m
