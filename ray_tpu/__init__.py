@@ -121,6 +121,8 @@ def init(address: Optional[str] = None, *,
         runtime.start()
         runtime.gcs_call("add_job", job_id=job_id, driver_addr=runtime.address.addr,
                          meta={"namespace": namespace, "pid": os.getpid()})
+        if cfg.log_to_driver:
+            runtime.subscribe_logs()
         _session = {
             "address": f"{gcs_addr[0]}:{gcs_addr[1]}",
             "session_dir": session_dir,

@@ -1,0 +1,17 @@
+"""ray_tpu.autoscaler: demand-driven node scale-up/down.
+
+Reference: python/ray/autoscaler/_private/ — StandardAutoscaler
+(autoscaler.py:166) driven by Monitor (monitor.py:126) reading GCS resource
+state; LoadMetrics (load_metrics.py:63); NodeProvider plugin API
+(autoscaler/node_provider.py). TPU-specific: providers allocate whole
+slices, not single VMs — a "node" is one TPU VM host carrying its slice
+topology labels, and scale-up for an SPMD job means provisioning a full
+slice's worth of hosts at once (QueuedResources/GKE provider planned;
+LocalNodeProvider here exercises the control loop like the reference's
+FakeMultiNodeProvider, fake_multi_node/node_provider.py:237).
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider"]

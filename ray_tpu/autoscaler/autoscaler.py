@@ -1,0 +1,71 @@
+"""StandardAutoscaler: poll GCS load, launch/terminate via the provider.
+
+Reference: autoscaler.py:166 update loop + resource_demand_scheduler.py:101
+(bin-packing of demand into node types). Round-1 policy: scale up one node
+of the matching type per update while unmet demand or pending leases
+persist; scale down autoscaled nodes idle past idle_timeout.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.core.common import ResourceSet
+
+
+class StandardAutoscaler:
+    def __init__(self, gcs_call, provider: NodeProvider,
+                 node_types: Dict[str, Dict[str, float]],
+                 max_nodes: int = 8, idle_timeout_s: float = 60.0):
+        """gcs_call(method, **kw) — a bound caller (Runtime.gcs_call)."""
+        self.gcs_call = gcs_call
+        self.provider = provider
+        self.node_types = node_types
+        self.max_nodes = max_nodes
+        self.idle_timeout_s = idle_timeout_s
+        self._idle_since: Dict[str, float] = {}
+
+    def _pick_type(self, demand: Dict[str, float]) -> Optional[str]:
+        req = ResourceSet({k: float(v) for k, v in demand.items()})
+        for name, res in self.node_types.items():
+            if req.fits_in(ResourceSet({k: float(v) for k, v in res.items()})):
+                return name
+        return None
+
+    def update(self) -> dict:
+        """One reconcile step; returns actions taken (ref: autoscaler.py
+        StandardAutoscaler.update)."""
+        load = self.gcs_call("get_load")
+        actions = {"launched": [], "terminated": []}
+        n_alive = len(self.provider.non_terminated_nodes())
+
+        # scale up on unmet demand
+        wanted_types: List[str] = []
+        for d in load["unmet_demand"]:
+            t = self._pick_type(d["resources"])
+            if t:
+                wanted_types.append(t)
+        if not wanted_types and any(v > 0 for v in
+                                    load["pending_leases"].values()):
+            wanted_types.append(next(iter(self.node_types)))
+        for t in wanted_types[:max(0, self.max_nodes - n_alive)]:
+            nid = self.provider.create_node(t, self.node_types[t])
+            actions["launched"].append(nid)
+            break  # one per update, like conservative upscaling
+
+        # scale down idle autoscaled nodes
+        now = time.time()
+        idle_gcs = set(load["idle_nodes"])
+        for pname in self.provider.non_terminated_nodes():
+            gcs_id = getattr(self.provider, "node_id_of", lambda _: None)(pname)
+            if gcs_id is not None and gcs_id in idle_gcs:
+                since = self._idle_since.setdefault(pname, now)
+                if now - since > self.idle_timeout_s:
+                    self.provider.terminate_node(pname)
+                    actions["terminated"].append(pname)
+                    self._idle_since.pop(pname, None)
+            else:
+                self._idle_since.pop(pname, None)
+        return actions

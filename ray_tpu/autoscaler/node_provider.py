@@ -1,0 +1,72 @@
+"""NodeProvider plugin API + local provider.
+
+Reference: python/ray/autoscaler/node_provider.py (create/terminate/
+non_terminated_nodes) and the fake multi-node provider
+(fake_multi_node/node_provider.py:237) used to test scaling logic without a
+cloud.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider:
+    """Subclass for real clouds (GKE TPU slices, QueuedResources)."""
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns real nodelets on this machine (one per 'node')."""
+
+    def __init__(self, gcs_addr, session_dir: str, cfg=None):
+        from ray_tpu.core.config import Config
+
+        self.gcs_addr = tuple(gcs_addr)
+        self.session_dir = session_dir
+        self.cfg = cfg or Config.load()
+        self.nodes: Dict[str, Any] = {}
+        self._counter = 0
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> str:
+        from ray_tpu.core.node import start_nodelet
+
+        self._counter += 1
+        name = f"auto-{self._counter}"
+        proc, addr, node_id_hex, store = start_nodelet(
+            self.session_dir, self.cfg, self.gcs_addr, resources=resources,
+            labels={"autoscaled": True, "node_type": node_type},
+            log_name=f"nodelet-{name}")
+        self.nodes[name] = {"proc": proc, "addr": addr,
+                            "node_id": node_id_hex}
+        return name
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        rec = self.nodes.pop(provider_node_id, None)
+        if rec:
+            try:
+                rec["proc"].terminate()
+                rec["proc"].wait(timeout=5)
+            except Exception:
+                try:
+                    rec["proc"].kill()
+                except Exception:
+                    pass
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [k for k, v in self.nodes.items()
+                if v["proc"].poll() is None]
+
+    def node_id_of(self, provider_node_id: str) -> Optional[str]:
+        rec = self.nodes.get(provider_node_id)
+        return rec["node_id"] if rec else None

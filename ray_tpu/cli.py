@@ -1,0 +1,161 @@
+"""Command-line interface: `python -m ray_tpu.cli <cmd>`.
+
+Reference: python/ray/scripts/scripts.py — start/stop (:540,:1004), status,
+timeline (:1835), memory (:1900), and the state CLI (`ray list ...`,
+util/state/state_cli.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def cmd_start(args):
+    """Start a head (gcs + nodelet) that outlives this command."""
+    from ray_tpu.core.config import Config
+    from ray_tpu.core.node import new_session_dir, start_gcs, start_nodelet
+
+    cfg = Config.load(json.loads(args.system_config))
+    session_dir = new_session_dir()
+    gcs_proc, gcs_addr = start_gcs(session_dir, cfg, host=args.host,
+                                   port=args.port)
+    resources = json.loads(args.resources)
+    nodelet_proc, nodelet_addr, node_id, store = start_nodelet(
+        session_dir, cfg, gcs_addr, resources=resources, host=args.host)
+    info = {"address": f"{gcs_addr[0]}:{gcs_addr[1]}",
+            "session_dir": session_dir,
+            "gcs_pid": gcs_proc.pid, "nodelet_pid": nodelet_proc.pid}
+    with open(os.path.join(session_dir, "head.json"), "w") as f:
+        json.dump(info, f)
+    print(json.dumps(info, indent=2))
+    print(f"\nConnect with: ray_tpu.init(address='{info['address']}')")
+
+
+def cmd_stop(args):
+    """Stop daemons of the latest session (ref: ray stop)."""
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+    latest = os.path.join(base, "session_latest", "head.json")
+    if not os.path.exists(latest):
+        print("no running head found")
+        return
+    with open(latest) as f:
+        info = json.load(f)
+    import signal
+
+    for key in ("nodelet_pid", "gcs_pid"):
+        try:
+            os.kill(info[key], signal.SIGTERM)
+            print(f"stopped {key} {info[key]}")
+        except ProcessLookupError:
+            pass
+
+
+def cmd_status(args):
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.cluster_summary(), indent=2, default=str))
+
+
+def cmd_list(args):
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import state
+
+    fn = {"nodes": state.list_nodes, "actors": state.list_actors,
+          "tasks": state.list_tasks, "jobs": state.list_jobs}[args.what]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_summary(args):
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.summarize_tasks(), indent=2, default=str))
+
+
+def cmd_timeline(args):
+    """Chrome-trace export of task events (ref: ray timeline)."""
+    ray_tpu = _connect(args.address)
+    events = ray_tpu.timeline(limit=args.limit)
+    trace = []
+    starts = {}
+    for ev in reversed(events):
+        key = ev["task_id"]
+        if ev["state"] == "RUNNING":
+            starts[key] = ev["ts"]
+        elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
+            trace.append({
+                "name": ev["name"], "cat": "task", "ph": "X",
+                "ts": starts[key] * 1e6,
+                "dur": (ev["ts"] - starts.pop(key)) * 1e6,
+                "pid": 0, "tid": hash(key) % 64,
+                "args": {"state": ev["state"]},
+            })
+    out = args.output or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} spans to {out}")
+
+
+def cmd_memory(args):
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.memory_summary(), indent=2, default=str))
+
+
+def cmd_metrics(args):
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util.metrics import prometheus_text
+
+    print(prometheus_text())
+
+
+def main():
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start head daemons")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--resources", default="{}")
+    s.add_argument("--system-config", default="{}")
+    s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("stop", help="stop head daemons")
+    s.set_defaults(fn=cmd_stop)
+
+    for name, fn in [("status", cmd_status), ("summary", cmd_summary),
+                     ("memory", cmd_memory), ("metrics", cmd_metrics)]:
+        s = sub.add_parser(name)
+        s.add_argument("--address", required=True)
+        s.set_defaults(fn=fn)
+
+    s = sub.add_parser("list")
+    s.add_argument("what", choices=["nodes", "actors", "tasks", "jobs"])
+    s.add_argument("--address", required=True)
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("timeline")
+    s.add_argument("--address", required=True)
+    s.add_argument("--limit", type=int, default=10000)
+    s.add_argument("--output", default=None)
+    s.set_defaults(fn=cmd_timeline)
+
+    args = p.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,8 @@ class GcsServer:
         self.kv: Dict[Tuple[str, bytes], bytes] = {}
         self.pgs: Dict[PlacementGroupID, dict] = {}
         self.subscribers: Dict[str, set] = defaultdict(set)  # channel -> {addr}
+        self.pending_leases: Dict[NodeID, int] = {}
+        self.unmet_demand: List[dict] = []  # infeasible resource asks
         self.task_events: deque = deque(maxlen=cfg.task_event_buffer_size)
         self.pool = ClientPool()
         self.server = RpcServer(self)
@@ -145,12 +147,14 @@ class GcsServer:
         return {"ok": True, "config": self.cfg.to_json()}
 
     async def rpc_heartbeat(self, node_id: NodeID, seqno: int,
-                            available: ResourceSet) -> dict:
+                            available: ResourceSet,
+                            pending_leases: int = 0) -> dict:
         # ref: ray_syncer.h versioned snapshots — stale seqnos are dropped.
         if seqno >= self.heartbeat_seq.get(node_id, -1):
             self.heartbeat_seq[node_id] = seqno
             if node_id in self.nodes:
                 self.available[node_id] = available
+                self.pending_leases[node_id] = pending_leases
         self.last_seen[node_id] = time.time()
         info = self.nodes.get(node_id)
         if info is not None and not info.alive:
@@ -169,6 +173,21 @@ class GcsServer:
 
     async def rpc_get_available_resources(self) -> Dict[bytes, Dict[str, float]]:
         return {nid.binary(): rs.quantities for nid, rs in self.available.items()}
+
+    async def rpc_get_load(self) -> dict:
+        """Cluster load for the autoscaler (ref: LoadMetrics
+        load_metrics.py:63 fed from GCS resource state)."""
+        now = time.time()
+        return {
+            "pending_leases": {nid.hex(): n
+                               for nid, n in self.pending_leases.items()},
+            "unmet_demand": [d for d in self.unmet_demand
+                             if now - d["ts"] < 30.0],
+            "idle_nodes": [nid.hex() for nid, info in self.nodes.items()
+                           if info.alive and self.available.get(nid) is not None
+                           and self.available[nid].quantities ==
+                           info.resources_total.quantities],
+        }
 
     # ------------------------------------------------------------- scheduling
 
@@ -193,6 +212,11 @@ class GcsServer:
         exclude_set = set(exclude) if exclude else None
         cands = self._feasible_nodes(resources, exclude_set)
         if not cands:
+            # record unmet demand for the autoscaler
+            # (ref: infeasible queue -> gcs_autoscaler_state_manager.h)
+            self.unmet_demand.append({"resources": resources.quantities,
+                                      "ts": time.time()})
+            del self.unmet_demand[:-100]
             return None
         if strategy_kind == "SPREAD":
             self._round_robin += 1

@@ -105,6 +105,7 @@ class Nodelet:
         loop = asyncio.get_running_loop()
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._reap_loop())
+        loop.create_task(self._log_loop())
         for _ in range(self.cfg.worker_pool_prestart):
             loop.create_task(self._start_worker())
         return addr
@@ -116,7 +117,8 @@ class Nodelet:
             self._hb_seq += 1
             try:
                 await gcs.call("heartbeat", node_id=self.node_id, seqno=self._hb_seq,
-                               available=self.available, timeout=5.0)
+                               available=self.available,
+                               pending_leases=len(self.pending), timeout=5.0)
             except (ConnectionLost, RemoteError, OSError):
                 pass
             await asyncio.sleep(period)
@@ -146,6 +148,43 @@ class Nodelet:
                       and now - w.last_idle > self.cfg.worker_idle_timeout_s
                       and len(self.workers) > self.cfg.worker_pool_prestart):
                     self._kill_worker(w, "idle timeout")
+
+    async def _log_loop(self):
+        """Tail worker stdout/stderr files and publish new lines to the
+        driver via GCS pubsub (ref: _private/log_monitor.py:102 → driver
+        print_to_stdstream worker.py:1758)."""
+        offsets: Dict[str, int] = {}
+        gcs = self.pool.get(self.gcs_addr)
+        logdir = os.path.join(self.session_dir, "logs")
+        import glob
+
+        while not self._stopping:
+            await asyncio.sleep(0.5)
+            lines = []
+            for path in glob.glob(os.path.join(logdir, "worker-*.out")) + \
+                    glob.glob(os.path.join(logdir, "worker-*.err")):
+                try:
+                    size = os.path.getsize(path)
+                    off = offsets.get(path, 0)
+                    if size > off:
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            chunk = f.read(min(size - off, 1 << 20))
+                        offsets[path] = off + len(chunk)
+                        stream = "err" if path.endswith(".err") else "out"
+                        src = os.path.basename(path).rsplit(".", 1)[0]
+                        for ln in chunk.decode(errors="replace").splitlines():
+                            lines.append({"source": src, "stream": stream,
+                                          "line": ln})
+                except OSError:
+                    continue
+            if lines:
+                try:
+                    await gcs.call("publish", channel="log",
+                                   message={"node": self.node_id.hex()[:8],
+                                            "lines": lines}, timeout=5.0)
+                except Exception:
+                    pass
 
     def _on_worker_dead(self, w: WorkerRecord):
         w.state = "dead"

@@ -95,9 +95,15 @@ class SharedMemoryStore:
         self._view = memoryview(self._mm)
         self._created = create
 
+    @property
+    def closed(self) -> bool:
+        return not self._h
+
     # -- raw byte API --------------------------------------------------------
 
     def put_bytes(self, oid: ObjectID, data: bytes) -> bool:
+        if not self._h:
+            raise RuntimeError("object store closed")
         rc = self._lib.ts_put(self._h, oid.binary(), data, len(data))
         if rc == -2:
             raise ObjectStoreFullError(
@@ -105,19 +111,27 @@ class SharedMemoryStore:
         return rc == 0  # False => already present (idempotent put)
 
     def create_view(self, oid: ObjectID, size: int) -> Optional[memoryview]:
+        if not self._h:
+            return None
         off = self._lib.ts_create_buf(self._h, oid.binary(), size)
         if off == 0:
             return None
         return self._view[off:off + size]
 
     def seal(self, oid: ObjectID) -> None:
+        if not self._h:
+            return
         self._lib.ts_seal(self._h, oid.binary())
 
     def abort(self, oid: ObjectID) -> None:
+        if not self._h:
+            return
         self._lib.ts_abort(self._h, oid.binary())
 
     def get_view(self, oid: ObjectID) -> Optional[memoryview]:
         """Pins the object; caller must release(oid) when the view is dropped."""
+        if not self._h:
+            return None
         size = ctypes.c_uint64()
         off = self._lib.ts_get(self._h, oid.binary(), ctypes.byref(size))
         if off == 0:
@@ -125,12 +139,18 @@ class SharedMemoryStore:
         return self._view[off:off + size.value]
 
     def release(self, oid: ObjectID) -> None:
+        if not self._h:
+            return
         self._lib.ts_release(self._h, oid.binary())
 
     def contains(self, oid: ObjectID) -> bool:
+        if not self._h:
+            return False
         return bool(self._lib.ts_contains(self._h, oid.binary()))
 
     def delete(self, oid: ObjectID) -> None:
+        if not self._h:
+            return
         self._lib.ts_delete(self._h, oid.binary())
 
     # -- object API ----------------------------------------------------------

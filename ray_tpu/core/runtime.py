@@ -182,10 +182,14 @@ class Runtime:
         return fut.result(timeout)
 
     def _spawn(self, coro):
-        if self.loop_thread is not None:
-            self.loop_thread.spawn(coro)
-        else:
-            self.loop.call_soon_threadsafe(lambda: self.loop.create_task(coro))
+        try:
+            if self.loop_thread is not None:
+                self.loop_thread.spawn(coro)
+            else:
+                self.loop.call_soon_threadsafe(
+                    lambda: self.loop.create_task(coro))
+        except RuntimeError:
+            coro.close()  # loop already shut down (late GC callbacks)
 
     def _self_addr(self) -> Optional[RuntimeAddress]:
         return self.address
@@ -625,13 +629,15 @@ class Runtime:
                 if n.node_id == spec.scheduling.node_id:
                     target = n.nodelet_addr
                     break
-        for _ in range(16):  # bounded spillback hops
+        deadline = time.time() + self.cfg.worker_lease_timeout_s * 4
+        while time.time() < deadline:
             try:
                 r = await self.pool.get(tuple(target)).call(
                     "request_lease", resources=spec.resources, pg=pg,
                     timeout=self.cfg.worker_lease_timeout_s + 10.0)
             except (ConnectionLost, RemoteError, OSError) as e:
                 logger.warning("lease request to %s failed: %s", target, e)
+                target = self.nodelet_addr
                 await asyncio.sleep(0.2)
                 continue
             st = r["status"]
@@ -645,19 +651,26 @@ class Runtime:
                 await asyncio.sleep(0.05)
                 continue
             if st == "infeasible":
-                # Same scheduling class == same resource demand: the whole
-                # queue is infeasible (ref: infeasible queue surfaced to
-                # autoscaler; without one, surface the error to callers).
-                q = self._queues[spec.scheduling_class()]
-                failed = {spec.task_id}
-                self._fail_task_returns(
-                    spec, RuntimeError(f"infeasible task: {r.get('error')}"))
-                while q:
-                    s = q.popleft()
-                    if s.task_id not in failed:
-                        self._fail_task_returns(
-                            s, RuntimeError(f"infeasible task: {r.get('error')}"))
-                return None
+                # Stay pending while the cluster may grow (the reference
+                # parks infeasible tasks in a queue surfaced to the
+                # autoscaler; our GCS records the unmet demand on every
+                # pick_node miss). Fail only after the extended deadline.
+                await asyncio.sleep(0.5)
+                target = self.nodelet_addr
+                continue
+        # Deadline expired with the task still unschedulable. Same scheduling
+        # class == same resource demand, so the whole queue is infeasible
+        # (ref: infeasible queue surfaced to the autoscaler; we surface the
+        # error to callers after the grace window).
+        err = RuntimeError(
+            f"infeasible task: no node can satisfy "
+            f"{spec.resources.quantities} within deadline")
+        q = self._queues[spec.scheduling_class()]
+        self._fail_task_returns(spec, err)
+        while q:
+            s = q.popleft()
+            if s.task_id != spec.task_id:
+                self._fail_task_returns(s, err)
         return None
 
     async def _return_lease(self, lw: _LeasedWorker):
@@ -789,7 +802,26 @@ class Runtime:
             self._on_log(message)
 
     def _on_log(self, message: dict):
-        pass  # driver overrides via api layer
+        """Driver-side worker log fan-in (ref: worker.py:1758
+        print_to_stdstream)."""
+        if self.mode != "driver" or not self.cfg.log_to_driver:
+            return
+        import sys
+
+        for entry in message.get("lines", []):
+            stream = sys.stderr if entry.get("stream") == "err" else sys.stdout
+            print(f"({entry.get('source', '?')}) {entry.get('line', '')}",
+                  file=stream)
+
+    def subscribe_logs(self):
+        async def _sub():
+            try:
+                await self.pool.get(self.gcs_addr).call(
+                    "subscribe", channel="log", addr=self.address.addr,
+                    timeout=5.0)
+            except Exception:
+                pass
+        self._spawn(_sub())
 
     def _resolve_actor(self, actor_id: ActorID, timeout: float = 60.0) -> Address:
         addr = self._actor_addr.get(actor_id)

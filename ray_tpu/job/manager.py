@@ -1,0 +1,142 @@
+"""Job supervisor + client.
+
+Reference: dashboard/modules/job/job_manager.py — JobSupervisor (:140) is an
+actor that runs the entrypoint as a subprocess, polls it, and exposes
+status/logs; JobManager (:516) tracks jobs in GCS KV.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+@ray_tpu.remote
+class JobSupervisor:
+    """One per job; owns the entrypoint subprocess."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 runtime_env: Optional[dict] = None,
+                 working_dir: Optional[str] = None):
+        import subprocess
+        import tempfile
+
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.log_path = os.path.join(
+            tempfile.gettempdir(), f"ray_tpu_job_{job_id}.log")
+        env = dict(os.environ)
+        for k, v in (runtime_env or {}).get("env_vars", {}).items():
+            env[k] = str(v)
+        self.logf = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=self.logf, stderr=self.logf,
+            cwd=working_dir or os.getcwd(), env=env,
+            start_new_session=True)
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.stopped = False
+
+    def status(self) -> str:
+        rc = self.proc.poll()
+        if rc is None:
+            return JobStatus.RUNNING
+        if self.end_time is None:
+            self.end_time = time.time()
+            self.logf.flush()
+        if self.stopped:
+            return JobStatus.STOPPED
+        return JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+
+    def logs(self) -> str:
+        self.logf.flush()
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def stop(self) -> bool:
+        if self.proc.poll() is None:
+            self.stopped = True
+            import signal
+
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except Exception:
+                self.proc.terminate()
+        return True
+
+    def info(self) -> dict:
+        return {"job_id": self.job_id, "entrypoint": self.entrypoint,
+                "status": self.status(), "start_time": self.start_time,
+                "end_time": self.end_time}
+
+
+class JobSubmissionClient:
+    """ref: python/ray/job_submission SDK surface."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        self._n = 0
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[dict] = None,
+                   working_dir: Optional[str] = None,
+                   submission_id: Optional[str] = None) -> str:
+        job_id = submission_id or f"raytpu-job-{int(time.time())}-{self._n}"
+        self._n += 1
+        sup = JobSupervisor.options(
+            name=f"_job_{job_id}", namespace="job",
+            num_cpus=0.1, max_concurrency=4).remote(
+            job_id, entrypoint, runtime_env, working_dir)
+        # register in GCS KV for listing
+        from ray_tpu.core import runtime as rt
+
+        rt.get_runtime().kv_put("jobs", job_id.encode(),
+                                json.dumps({"entrypoint": entrypoint,
+                                            "submitted": time.time()}).encode())
+        return job_id
+
+    def _sup(self, job_id: str):
+        return ray_tpu.get_actor(f"_job_{job_id}", namespace="job")
+
+    def get_job_status(self, job_id: str) -> str:
+        return ray_tpu.get(self._sup(job_id).status.remote())
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._sup(job_id).logs.remote())
+
+    def get_job_info(self, job_id: str) -> dict:
+        return ray_tpu.get(self._sup(job_id).info.remote())
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._sup(job_id).stop.remote())
+
+    def list_jobs(self) -> List[str]:
+        from ray_tpu.core import runtime as rt
+
+        return [k.decode() for k in
+                rt.get_runtime().gcs_call("kv_keys", ns="jobs")]
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = self.get_job_status(job_id)
+            if st in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return st
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

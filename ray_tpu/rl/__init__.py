@@ -1,0 +1,19 @@
+"""ray_tpu.rl: reinforcement learning — TPU learner, CPU rollout actors.
+
+Reference: rllib/ — Algorithm (algorithms/algorithm.py:813 step,
+:1400 training_step) over a WorkerSet of RolloutWorker actors
+(evaluation/worker_set.py, rollout_worker.py) and the new API stack's
+Learner/LearnerGroup (core/learner/learner_group.py:61). The TPU-native
+split (BASELINE.md config 5): env sampling stays on CPU actor fleets;
+the policy update is one jitted SPMD step on the TPU mesh.
+
+    from ray_tpu.rl import PPOConfig, PPOTrainer
+
+    trainer = PPOTrainer(PPOConfig(env="CartPole-v1", num_rollout_workers=2))
+    for _ in range(10):
+        metrics = trainer.train()
+"""
+
+from ray_tpu.rl.ppo import PPOConfig, PPOTrainer
+
+__all__ = ["PPOConfig", "PPOTrainer"]

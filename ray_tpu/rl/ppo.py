@@ -1,0 +1,272 @@
+"""PPO: clipped surrogate objective, GAE, rollout-actor fleet + jitted learner.
+
+Reference: rllib/algorithms/ppo/ (config + training_step) and
+rllib/evaluation/rollout_worker.py sampling. Env interface is gymnasium
+(available in-image); policy/value nets are small MLPs in pure JAX.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+
+# --- policy (pure JAX, shared by learner and rollout workers) ----------------
+
+
+def init_policy(key, obs_dim: int, n_actions: int, hidden: int = 64):
+    import jax
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def dense(k, i, o):
+        return {"w": jax.random.normal(k, (i, o)) * (2.0 / i) ** 0.5,
+                "b": jax.numpy.zeros((o,))}
+
+    return {
+        "torso": [dense(k1, obs_dim, hidden), dense(k2, hidden, hidden)],
+        "pi": dense(k3, hidden, n_actions),
+        "v": dense(k4, hidden, 1),
+    }
+
+
+def policy_forward(params, obs):
+    import jax.numpy as jnp
+
+    x = obs
+    for layer in params["torso"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["v"]["w"] + params["v"]["b"])[..., 0]
+    return logits, value
+
+
+# --- rollout worker (CPU actor) ---------------------------------------------
+
+
+@ray_tpu.remote
+class RolloutWorker:
+    """Samples env steps with the latest policy weights
+    (ref: rollout_worker.py; sampler.py)."""
+
+    def __init__(self, env_name: str, seed: int = 0,
+                 env_config: Optional[dict] = None):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import gymnasium as gym
+
+        self.env = gym.make(env_name, **(env_config or {}))
+        self.seed = seed
+        self.obs, _ = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+        self.completed_returns: List[float] = []
+
+    def sample(self, params_host, num_steps: int) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(self.seed + len(self.completed_returns))
+        obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = \
+            [], [], [], [], [], []
+        for _ in range(num_steps):
+            logits, value = policy_forward(params_host,
+                                           jnp.asarray(self.obs)[None])
+            logits = np.asarray(logits)[0]
+            p = np.exp(logits - logits.max())
+            p = p / p.sum()
+            action = int(rng.choice(len(p), p=p))
+            logp = float(np.log(p[action] + 1e-9))
+            nobs, rew, term, trunc, _ = self.env.step(action)
+            done = bool(term or trunc)
+            obs_buf.append(np.asarray(self.obs, np.float32))
+            act_buf.append(action)
+            rew_buf.append(float(rew))
+            done_buf.append(done)
+            logp_buf.append(logp)
+            val_buf.append(float(np.asarray(value)[0]))
+            self.episode_return += float(rew)
+            if done:
+                self.completed_returns.append(self.episode_return)
+                self.episode_return = 0.0
+                nobs, _ = self.env.reset()
+            self.obs = nobs
+        # bootstrap value for the final state
+        _, last_v = policy_forward(params_host, jnp.asarray(self.obs)[None])
+        return {
+            "obs": np.stack(obs_buf),
+            "actions": np.asarray(act_buf, np.int32),
+            "rewards": np.asarray(rew_buf, np.float32),
+            "dones": np.asarray(done_buf, np.bool_),
+            "logp": np.asarray(logp_buf, np.float32),
+            "values": np.asarray(val_buf, np.float32),
+            "last_value": float(np.asarray(last_v)[0]),
+        }
+
+    def episode_stats(self) -> Dict[str, float]:
+        rets = self.completed_returns[-20:]
+        return {"episodes": len(self.completed_returns),
+                "mean_return": float(np.mean(rets)) if rets else 0.0}
+
+
+# --- GAE + learner -----------------------------------------------------------
+
+
+def compute_gae(batch: dict, gamma: float, lam: float):
+    rew, done, val = batch["rewards"], batch["dones"], batch["values"]
+    T = len(rew)
+    adv = np.zeros(T, np.float32)
+    last_gae = 0.0
+    next_v = batch["last_value"]
+    for t in reversed(range(T)):
+        nonterminal = 0.0 if done[t] else 1.0
+        delta = rew[t] + gamma * next_v * nonterminal - val[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_v = val[t]
+    returns = adv + val
+    return adv, returns
+
+
+@dataclass
+class PPOConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 200
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    hidden: int = 64
+    seed: int = 0
+
+
+class PPOTrainer:
+    """ref: Algorithm.training_step (algorithm.py:1400) — sample via the
+    worker fleet, update on device, broadcast new weights."""
+
+    def __init__(self, config: PPOConfig):
+        import gymnasium as gym
+        import jax
+        import optax
+
+        self.cfg = config
+        probe = gym.make(config.env, **config.env_config)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+
+        self.params = init_policy(jax.random.PRNGKey(config.seed), obs_dim,
+                                  n_actions, config.hidden)
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.workers = [
+            RolloutWorker.options(num_cpus=0.5).remote(
+                config.env, seed=config.seed + i * 1000,
+                env_config=config.env_config)
+            for i in range(config.num_rollout_workers)]
+        self._update = jax.jit(self._make_update())
+        self.iteration = 0
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+
+        def loss_fn(params, mb):
+            logits, value = policy_forward(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, mb["actions"][:, None],
+                                       axis=-1)[:, 0]
+            ratio = jnp.exp(logp - mb["logp"])
+            adv = mb["adv"]
+            pg = -jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv).mean()
+            vf = 0.5 * jnp.square(value - mb["returns"]).mean()
+            ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+            return total, {"pg_loss": pg, "vf_loss": vf, "entropy": ent}
+
+        def update(params, opt_state, mb):
+            (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = total
+            return params, opt_state, aux
+
+        return update
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.time()
+        params_host = jax.device_get(self.params)
+        refs = [w.sample.remote(params_host, self.cfg.rollout_fragment_length)
+                for w in self.workers]
+        batches = ray_tpu.get(refs)
+
+        obs, acts, logps, advs, rets = [], [], [], [], []
+        for b in batches:
+            adv, ret = compute_gae(b, self.cfg.gamma, self.cfg.lam)
+            obs.append(b["obs"])
+            acts.append(b["actions"])
+            logps.append(b["logp"])
+            advs.append(adv)
+            rets.append(ret)
+        obs = np.concatenate(obs)
+        acts = np.concatenate(acts)
+        logps = np.concatenate(logps)
+        advs = np.concatenate(advs)
+        advs = (advs - advs.mean()) / (advs.std() + 1e-8)
+        rets = np.concatenate(rets)
+
+        n = len(obs)
+        rng = np.random.default_rng(self.iteration)
+        aux = {}
+        for _ in range(self.cfg.num_epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n, self.cfg.minibatch_size):
+                idx = perm[lo:lo + self.cfg.minibatch_size]
+                if len(idx) < 2:
+                    continue
+                mb = {"obs": obs[idx], "actions": acts[idx],
+                      "logp": logps[idx], "adv": advs[idx],
+                      "returns": rets[idx]}
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, mb)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        mean_ret = float(np.mean([s["mean_return"] for s in stats
+                                  if s["episodes"]])) \
+            if any(s["episodes"] for s in stats) else 0.0
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "timesteps_this_iter": n,
+            "time_this_iter_s": time.time() - t0,
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

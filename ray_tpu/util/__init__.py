@@ -9,9 +9,10 @@ from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
 from ray_tpu.util.scheduling_strategies import (NodeAffinitySchedulingStrategy,
                                                 PlacementGroupSchedulingStrategy)
 from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Queue
 
 __all__ = [
     "PlacementGroup", "placement_group", "remove_placement_group",
     "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
-    "ActorPool",
+    "ActorPool", "Queue",
 ]

@@ -1,0 +1,119 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py:150,215,290 — metrics flow to the
+node agent and Prometheus. Here they aggregate in the GCS KV (namespace
+"metrics"); `ray_tpu.cli status`/state API expose them, and
+`prometheus_text()` renders the exposition format for scraping.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core import runtime as rt
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _flush(self, kind: str):
+        runtime = rt.current_runtime_or_none()
+        if runtime is None:
+            return
+        with self._lock:
+            payload = {
+                "kind": kind, "description": self.description,
+                "series": [{"tags": dict(k), "value": v,
+                            "count": self._counts.get(k, 0)}
+                           for k, v in self._values.items()],
+                "ts": time.time(),
+            }
+        try:
+            runtime.kv_put("metrics", self.name.encode(),
+                           json.dumps(payload).encode())
+        except Exception:
+            pass
+
+
+class Counter(_Metric):
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+        self._flush("counter")
+
+
+class Gauge(_Metric):
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = value
+        self._flush("gauge")
+
+
+class Histogram(_Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = boundaries or [0.01, 0.05, 0.1, 0.5, 1, 5, 10]
+        self._sums: Dict[Tuple, float] = {}
+        self._buckets: Dict[Tuple, List[int]] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        k = self._key(tags)
+        with self._lock:
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+            b = self._buckets.setdefault(k, [0] * (len(self.boundaries) + 1))
+            for i, bound in enumerate(self.boundaries):
+                if value <= bound:
+                    b[i] += 1
+                    break
+            else:
+                b[-1] += 1
+            self._values[k] = self._sums[k] / self._counts[k]  # mean
+        self._flush("histogram")
+
+
+def prometheus_text() -> str:
+    """Render all reported metrics in Prometheus exposition format
+    (ref: metrics_agent.py Prometheus export)."""
+    runtime = rt.get_runtime()
+    lines = []
+    for key in runtime.gcs_call("kv_keys", ns="metrics"):
+        raw = runtime.kv_get("metrics", key)
+        if raw is None:
+            continue
+        data = json.loads(raw)
+        name = key.decode()
+        if data.get("description"):
+            lines.append(f"# HELP {name} {data['description']}")
+        lines.append(f"# TYPE {name} {data['kind']}")
+        for s in data["series"]:
+            tags = ",".join(f'{k}="{v}"' for k, v in s["tags"].items())
+            label = f"{{{tags}}}" if tags else ""
+            lines.append(f"{name}{label} {s['value']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,89 @@
+"""State/observability API.
+
+Reference: python/ray/util/state/api.py:109 (StateApiClient; list_actors:782,
+list_tasks:1009) backed by the GCS task/actor/node tables.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core import runtime as rt
+
+
+def list_nodes() -> List[dict]:
+    out = []
+    for n in rt.get_runtime().gcs_call("get_nodes"):
+        out.append({"node_id": n.node_id.hex(), "alive": n.alive,
+                    "resources": n.resources_total.quantities,
+                    "labels": n.labels, "address": n.nodelet_addr})
+    return out
+
+
+def list_actors(state: Optional[str] = None) -> List[dict]:
+    out = []
+    for a in rt.get_runtime().gcs_call("list_actors"):
+        if state and a["state"] != state:
+            continue
+        out.append({"actor_id": a["actor_id"].hex(), "state": a["state"],
+                    "class_name": a["class_name"], "name": a["name"],
+                    "namespace": a["namespace"],
+                    "num_restarts": a["num_restarts"],
+                    "address": a["address"]})
+    return out
+
+
+def list_tasks(limit: int = 1000) -> List[dict]:
+    return rt.get_runtime().gcs_call("list_task_events", limit=limit)
+
+
+def list_jobs() -> List[dict]:
+    out = []
+    for j in rt.get_runtime().gcs_call("list_jobs"):
+        out.append({"job_id": j["job_id"].hex(), "driver": j["driver"],
+                    "start": j["start"], "end": j["end"], "meta": j["meta"]})
+    return out
+
+
+def list_placement_groups() -> List[dict]:
+    # round-1: PGs are queried per-id; a GCS listing lands with the
+    # observability milestone
+    return []
+
+
+def summarize_tasks(limit: int = 5000) -> Dict[str, Dict[str, int]]:
+    """ref: `ray summary tasks` (state_cli.py)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for ev in list_tasks(limit):
+        name = ev.get("name", "?")
+        state = ev.get("state", "?")
+        summary.setdefault(name, {})
+        summary[name][state] = summary[name].get(state, 0) + 1
+    return summary
+
+
+def cluster_summary() -> dict:
+    """ref: `ray status` output."""
+    import ray_tpu
+
+    nodes = list_nodes()
+    actors = list_actors()
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+        "total_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+        "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+        "actors_total": len(actors),
+    }
+
+
+def memory_summary() -> dict:
+    """Owner-side refcount stats (ref: `ray memory` scripts.py:1900)."""
+    runtime = rt.get_runtime()
+    stats = runtime.refs.stats()
+    stats["store_bytes_in_use"] = runtime.store.bytes_in_use()
+    stats["store_capacity"] = runtime.store.capacity()
+    stats["store_objects"] = runtime.store.num_objects()
+    stats["store_evictions"] = runtime.store.num_evictions()
+    return stats

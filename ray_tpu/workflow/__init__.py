@@ -1,0 +1,22 @@
+"""ray_tpu.workflow: durable step execution.
+
+Reference: python/ray/workflow/ (api.py:166 run_async, task_executor.py,
+storage/) — every step's result is persisted so a crashed workflow resumes
+from completed steps instead of recomputing.
+
+    from ray_tpu import workflow
+
+    @workflow.step
+    def fetch(): ...
+
+    @workflow.step
+    def process(x): ...
+
+    out = workflow.run(process.bind(fetch.bind()),
+                       workflow_id="my-flow", storage="/tmp/wf")
+    # re-running with the same workflow_id skips completed steps
+"""
+
+from ray_tpu.workflow.api import StepNode, run, step
+
+__all__ = ["step", "run", "StepNode"]

@@ -1,0 +1,92 @@
+"""Durable workflow DAG execution with per-step checkpoints.
+
+Reference: workflow/api.py + task_executor.py + storage/ — steps are content-
+addressed by (workflow_id, step name + arg lineage); results persist via
+pickle under the storage dir. Resume = skip steps whose result file exists.
+Step bodies execute as ray_tpu tasks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class StepNode:
+    def __init__(self, fn, args, kwargs, name=None, max_retries: int = 3):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or getattr(fn, "__name__", "step")
+        self.max_retries = max_retries
+
+    def key(self) -> str:
+        h = hashlib.sha1(self.name.encode())
+        for a in self.args:
+            h.update(a.key().encode() if isinstance(a, StepNode)
+                     else repr(a).encode())
+        for k in sorted(self.kwargs):
+            v = self.kwargs[k]
+            h.update(k.encode())
+            h.update(v.key().encode() if isinstance(v, StepNode)
+                     else repr(v).encode())
+        return f"{self.name}-{h.hexdigest()[:16]}"
+
+
+class _Step:
+    def __init__(self, fn, max_retries: int = 3):
+        self.fn = fn
+        self.max_retries = max_retries
+
+    def bind(self, *args, **kwargs) -> StepNode:
+        return StepNode(self.fn, args, kwargs, max_retries=self.max_retries)
+
+    def options(self, max_retries: int = 3) -> "_Step":
+        return _Step(self.fn, max_retries)
+
+
+def step(fn=None, *, max_retries: int = 3):
+    """@workflow.step decorator."""
+    if fn is not None:
+        return _Step(fn, max_retries)
+    return lambda f: _Step(f, max_retries)
+
+
+def _storage_path(storage: str, workflow_id: str, key: str) -> str:
+    d = os.path.join(storage, workflow_id, "steps")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, key + ".pkl")
+
+
+def run(node: StepNode, *, workflow_id: str, storage: str) -> Any:
+    """Execute the DAG depth-first; persist each step result; resume skips
+    persisted steps (ref: workflow durability contract)."""
+    memo: Dict[str, Any] = {}
+
+    def resolve(n: StepNode) -> Any:
+        key = n.key()
+        if key in memo:
+            return memo[key]
+        path = _storage_path(storage, workflow_id, key)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                out = pickle.load(f)
+            memo[key] = out
+            return out
+        args = [resolve(a) if isinstance(a, StepNode) else a for a in n.args]
+        kwargs = {k: (resolve(v) if isinstance(v, StepNode) else v)
+                  for k, v in n.kwargs.items()}
+        task = ray_tpu.remote(n.fn).options(max_retries=n.max_retries)
+        out = ray_tpu.get(task.remote(*args, **kwargs))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(out, f)
+        os.replace(tmp, path)
+        memo[key] = out
+        return out
+
+    return resolve(node)
