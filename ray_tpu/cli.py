@@ -122,6 +122,26 @@ def cmd_metrics(args):
     print(prometheus_text())
 
 
+def cmd_serve(args):
+    """serve deploy/status/shutdown (ref: serve/scripts.py CLI)."""
+    ray_tpu = _connect(args.address)
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.schema import ServeDeploySchema, apply_config
+
+        if not args.config:
+            raise SystemExit("serve deploy requires --config <file>")
+        schema = ServeDeploySchema.from_file(args.config)
+        info = apply_config(schema)
+        print(json.dumps(info, indent=2))
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def main():
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -152,6 +172,12 @@ def main():
     s.add_argument("--limit", type=int, default=10000)
     s.add_argument("--output", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("serve", help="serve deploy/status/shutdown")
+    s.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])
+    s.add_argument("--address", required=True)
+    s.add_argument("--config", default=None, help="config file for deploy")
+    s.set_defaults(fn=cmd_serve)
 
     args = p.parse_args()
     args.fn(args)

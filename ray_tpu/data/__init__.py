@@ -15,11 +15,14 @@ jax.device_put so the input pipeline overlaps the SPMD step (SURVEY.md §7.7).
 from ray_tpu.data.dataset import (Dataset, DataIterator, from_items,
                                   from_numpy, from_pandas, range as range_,
                                   read_csv, read_json, read_parquet)
+from ray_tpu.data import aggregate, preprocessors
+from ray_tpu.data.grouped import GroupedData
 
 # `range` shadows the builtin deliberately, matching the reference API
 range = range_
 
 __all__ = [
     "Dataset", "DataIterator", "from_items", "from_numpy", "from_pandas",
-    "range", "read_csv", "read_json", "read_parquet",
+    "range", "read_csv", "read_json", "read_parquet", "aggregate",
+    "preprocessors", "GroupedData",
 ]

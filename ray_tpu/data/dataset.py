@@ -259,6 +259,210 @@ class Dataset:
             parts[i % n].append(ref)
         return [DataIterator(p, list(self._ops)) for p in parts]
 
+    def groupby(self, key: str):
+        """Two-stage distributed groupby (ref: dataset.groupby →
+        grouped_data.py)."""
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed sample-sort (ref: dataset.sort → sort exchange op in
+        _internal/planner/exchange/sort_task_spec.py): sample keys to pick
+        range boundaries, range-partition blocks in map tasks, sort each
+        partition in reduce tasks."""
+        import ray_tpu
+
+        ops = self._ops
+        refs = self._block_refs
+        if not refs:
+            return Dataset([], [])
+        P = max(1, len(refs))
+
+        @ray_tpu.remote
+        def _sample(block):
+            block = _transform_block(block, ops)
+            if not isinstance(block, dict):
+                block = _rows_to_block(block)
+            if not isinstance(block, dict) or key not in block:
+                return np.empty(0)   # block emptied by transforms
+            col = np.asarray(block[key])
+            if len(col) == 0:
+                return col
+            k = min(64, len(col))
+            idx = np.random.default_rng(0).choice(len(col), size=k,
+                                                  replace=False)
+            return col[idx]
+
+        sampled = [s for s in ray_tpu.get([_sample.remote(r) for r in refs])
+                   if len(s)]
+        if not sampled:   # every block empty after transforms
+            return self.materialize()
+        samples = np.concatenate(sampled)
+        samples.sort()
+        bounds = [samples[int(len(samples) * (i + 1) / P)]
+                  for i in builtins.range(P - 1)]
+
+        @ray_tpu.remote
+        def _partition(block):
+            block = _transform_block(block, ops)
+            if not isinstance(block, dict):
+                block = _rows_to_block(block)
+            if not isinstance(block, dict) or key not in block:
+                empty = {}
+                return tuple(empty for _ in builtins.range(P)) \
+                    if P > 1 else empty
+            col = np.asarray(block[key])
+            part_ids = np.searchsorted(np.asarray(bounds), col, side="right")
+            out = []
+            for p in builtins.range(P):
+                idx = np.flatnonzero(part_ids == p)
+                out.append({c: v[idx] for c, v in block.items()})
+            return tuple(out) if P > 1 else out[0]
+
+        @ray_tpu.remote
+        def _sort_part(*subs):
+            whole = _block_concat([b for b in subs if _block_rows(b)])
+            if not _block_rows(whole):
+                return {}
+            order = np.argsort(np.asarray(whole[key]), kind="stable")
+            if descending:
+                order = order[::-1]
+            return {c: v[order] for c, v in whole.items()}
+
+        part_refs = [_partition.options(num_returns=P).remote(r)
+                     if P > 1 else [_partition.remote(r)] for r in refs]
+        out_refs = [_sort_part.remote(*[pr[p] for pr in part_refs])
+                    for p in builtins.range(P)]
+        if descending:
+            out_refs = out_refs[::-1]
+        return Dataset(out_refs, [])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of equal-length datasets (ref: dataset.zip).
+        Blocks stay in the object store: per-output-block merge tasks pull
+        only the row ranges they need from the right side."""
+        import ray_tpu
+
+        a = self.materialize()
+        b = other.materialize()
+
+        @ray_tpu.remote
+        def _rows(block):
+            return _block_rows(block)
+
+        na = ray_tpu.get([_rows.remote(r) for r in a._block_refs])
+        nb = ray_tpu.get([_rows.remote(r) for r in b._block_refs])
+        if sum(na) != sum(nb):
+            raise ValueError("zip requires equal row counts")
+
+        @ray_tpu.remote
+        def _merge(left, lo, hi, *right_parts):
+            """left block + the right-side row range [lo, hi) assembled
+            from the overlapping right blocks."""
+            right = _block_concat(list(right_parts))
+            right = _block_slice(right, lo, hi)
+            merged = dict(left) if isinstance(left, dict) else \
+                {"_left": np.asarray(left)}
+            rd = right if isinstance(right, dict) else \
+                {"_right": np.asarray(right)}
+            for c, v in rd.items():
+                merged[c if c not in merged else f"{c}_1"] = v
+            return merged
+
+        # offsets of each right block in global row space
+        b_starts = np.cumsum([0] + nb)
+        out_refs = []
+        pos = 0
+        for ref, n in builtins.zip(a._block_refs, na):
+            lo, hi = pos, pos + n
+            # right blocks overlapping [lo, hi)
+            first = int(np.searchsorted(b_starts, lo, side="right")) - 1
+            last = int(np.searchsorted(b_starts, hi, side="left"))
+            parts = b._block_refs[first:last]
+            out_refs.append(_merge.remote(
+                ref, lo - int(b_starts[first]),
+                hi - int(b_starts[first]), *parts))
+            pos = hi
+        return Dataset(out_refs, [])
+
+    def limit(self, n: int) -> "Dataset":
+        rows = self.take(n)
+        from ray_tpu.data.dataset import _put_blocks
+
+        return _put_blocks([_rows_to_block(rows)])
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]
+                   ) -> "Dataset":
+        def _add(block):
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return out
+
+        return self.map_batches(_add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {c: v for c, v in b.items() if c not in drop})
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        keep = list(cols)
+        return self.map_batches(lambda b: {c: b[c] for c in keep})
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(c, c): v for c, v in b.items()})
+
+    # ---- global aggregates -------------------------------------------------
+
+    def _global_agg(self, agg) -> Any:
+        import ray_tpu
+
+        ops = self._ops
+
+        @ray_tpu.remote
+        def _partial(block):
+            block = _transform_block(block, ops)
+            if not isinstance(block, dict):
+                col = np.asarray(block)
+            else:
+                col = np.asarray(block[agg.on]) if getattr(agg, "on", None) \
+                    else next(iter(block.values()))
+            return agg.accumulate_block(agg.init(), col)
+
+        partials = ray_tpu.get(
+            [_partial.remote(r) for r in self._block_refs])
+        acc = agg.init()
+        for p in partials:
+            acc = agg.merge(acc, p)
+        return agg.finalize(acc)
+
+    def sum(self, on: str):
+        from ray_tpu.data.aggregate import Sum
+
+        return self._global_agg(Sum(on))
+
+    def min(self, on: str):
+        from ray_tpu.data.aggregate import Min
+
+        return self._global_agg(Min(on))
+
+    def max(self, on: str):
+        from ray_tpu.data.aggregate import Max
+
+        return self._global_agg(Max(on))
+
+    def mean(self, on: str):
+        from ray_tpu.data.aggregate import Mean
+
+        return self._global_agg(Mean(on))
+
+    def std(self, on: str, ddof: int = 1):
+        from ray_tpu.data.aggregate import Std
+
+        return self._global_agg(Std(on, ddof))
+
     def union(self, other: "Dataset") -> "Dataset":
         if self._ops or other._ops:
             a = self.materialize()

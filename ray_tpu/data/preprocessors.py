@@ -1,0 +1,205 @@
+"""Preprocessors: fit on a Dataset, transform Datasets/batches.
+
+Reference: python/ray/data/preprocessors/ — Preprocessor base
+(fit/transform/transform_batch), scalers (scaler.py), encoders
+(encoder.py), imputer, concatenator, chain. Stats are computed with the
+dataset's distributed aggregates; transform is a map_batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Preprocessor:
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} not fitted")
+        return ds.map_batches(self.transform_batch)
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, ds):
+        pass
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]
+                        ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (ref: preprocessors/scaler.py)."""
+
+    def __init__(self, columns: List[str], ddof: int = 0):
+        self.columns = columns
+        self.ddof = ddof
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds):
+        for c in self.columns:
+            mean = ds.mean(c)
+            std = ds.std(c, ddof=self.ddof) or 0.0
+            self.stats_[c] = (mean, std if std > 0 else 1.0)
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            out[c] = (np.asarray(batch[c], dtype=np.float64) - mean) / std
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds):
+        for c in self.columns:
+            lo, hi = ds.min(c), ds.max(c)
+            self.stats_[c] = (lo, (hi - lo) or 1.0)
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            lo, span = self.stats_[c]
+            out[c] = (np.asarray(batch[c], dtype=np.float64) - lo) / span
+        return out
+
+
+def _distributed_unique(ds, column: str) -> np.ndarray:
+    """Per-block np.unique in remote tasks; only the (small) unique sets
+    reach the driver."""
+    uniq: set = set()
+    per_block = ds.select_columns([column]).map_batches(
+        lambda b: {column: np.unique(np.asarray(b[column]))})
+    for block in per_block._iter_blocks():
+        uniq.update(np.asarray(block[column]).tolist())
+    return np.asarray(sorted(uniq))
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical → ordinal int (ref: preprocessors/encoder.py)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Optional[np.ndarray] = None
+
+    def _fit(self, ds):
+        self.classes_ = _distributed_unique(ds, self.label_column)
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        lut = {v: i for i, v in enumerate(self.classes_.tolist())}
+        out[self.label_column] = np.asarray(
+            [lut[v] for v in np.asarray(batch[self.label_column]).tolist()])
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.classes_: Dict[str, np.ndarray] = {}
+
+    def _fit(self, ds):
+        for c in self.columns:
+            self.classes_[c] = _distributed_unique(ds, c)
+
+    def transform_batch(self, batch):
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        for c in self.columns:
+            vals = np.asarray(batch[c])
+            for cls in self.classes_[c].tolist():
+                out[f"{c}_{cls}"] = (vals == cls).astype(np.int64)
+        return out
+
+
+class SimpleImputer(Preprocessor):
+    """Fill NaNs with mean ('mean') or a constant ('constant')."""
+
+    def __init__(self, columns: List[str], strategy: str = "mean",
+                 fill_value: Any = 0.0):
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, float] = {}
+
+    def _needs_fit(self):
+        return self.strategy == "mean"
+
+    def _fit(self, ds):
+        if self.strategy != "mean":
+            return
+        for c in self.columns:
+            # NaN-aware mean over blocks
+            def _clean(b, c=c):
+                col = np.asarray(b[c], dtype=np.float64)
+                return {c: col[~np.isnan(col)]}
+
+            self.stats_[c] = ds.select_columns([c]).map_batches(_clean).mean(c)
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            col = np.asarray(batch[c], dtype=np.float64)
+            fill = self.stats_.get(c, self.fill_value)
+            out[c] = np.where(np.isnan(col), fill, col)
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Merge feature columns into one float matrix column (ref:
+    preprocessors/concatenator.py) — the standard last step before
+    feeding a jax model."""
+
+    def __init__(self, columns: List[str], output_column_name: str = "features",
+                 dtype=np.float32):
+        self.columns = columns
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self):
+        return False
+
+    def transform_batch(self, batch):
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        mats = [np.asarray(batch[c], dtype=self.dtype).reshape(
+            len(np.asarray(batch[c])), -1) for c in self.columns]
+        out[self.output_column_name] = np.concatenate(mats, axis=1)
+        return out
+
+
+class Chain(Preprocessor):
+    def __init__(self, *steps: Preprocessor):
+        self.steps = steps
+
+    def fit(self, ds):
+        for i, step in enumerate(self.steps):
+            step.fit(ds)
+            if i < len(self.steps) - 1:
+                ds = step.transform(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        for step in self.steps:
+            ds = step.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        for step in self.steps:
+            batch = step.transform_batch(batch)
+        return batch

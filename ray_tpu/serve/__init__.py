@@ -22,11 +22,16 @@ the design the reference lacks natively (SURVEY.md §7.9).
 """
 
 from ray_tpu.serve.api import (Application, Deployment, deployment,
-                               get_deployment_handle, run, shutdown)
+                               get_deployment_handle, run, shutdown, start,
+                               status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.http_proxy import Request, Response
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
-    "deployment", "run", "shutdown", "get_deployment_handle", "batch",
-    "Deployment", "Application", "DeploymentHandle",
+    "deployment", "run", "shutdown", "start", "status",
+    "get_deployment_handle", "batch", "Deployment", "Application",
+    "DeploymentHandle", "Request", "Response", "multiplexed",
+    "get_multiplexed_model_id",
 ]

@@ -84,9 +84,11 @@ def _get_or_start_controller():
             return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
 
 
-def run(app: Application, *, _blocking: bool = False) -> DeploymentHandle:
+def run(app: Application, *, route_prefix: Optional[str] = None,
+        _blocking: bool = False) -> DeploymentHandle:
     """Deploy every deployment in the app; returns the ingress handle
-    (ref: serve.run api.py:414)."""
+    (ref: serve.run api.py:414). route_prefix registers the ingress with
+    the HTTP proxy's route table."""
     controller = _get_or_start_controller()
     for d in app.deployments:
         from ray_tpu.core.runtime import _dumps_function
@@ -102,7 +104,39 @@ def run(app: Application, *, _blocking: bool = False) -> DeploymentHandle:
         }
         ray_tpu.get(controller.deploy.remote(
             d.name, blob, d.init_args, d.init_kwargs, config))
+    if route_prefix is not None:
+        ray_tpu.get(controller.set_route.remote(route_prefix,
+                                                app.ingress.name))
     return DeploymentHandle(app.ingress.name)
+
+
+def start(http_host: str = "127.0.0.1", http_port: int = 0,
+          detached: bool = True) -> int:
+    """Start the HTTP ingress proxy; returns the bound port (ref:
+    serve.start / _private/http_state.py proxy startup)."""
+    from ray_tpu.serve.http_proxy import HTTPProxy, PROXY_NAME
+
+    _get_or_start_controller()
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME, namespace=_NAMESPACE)
+    except ValueError:
+        try:
+            proxy = HTTPProxy.options(
+                name=PROXY_NAME, namespace=_NAMESPACE,
+                max_concurrency=64).remote(http_host, http_port)
+        except ValueError:
+            proxy = ray_tpu.get_actor(PROXY_NAME, namespace=_NAMESPACE)
+    return ray_tpu.get(proxy.ready.remote())
+
+
+def status() -> dict:
+    """Deployment + route table snapshot (ref: serve.status / REST GET)."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
+    except ValueError:
+        return {"deployments": {}, "routes": {}}
+    return {"deployments": ray_tpu.get(controller.list_deployments.remote()),
+            "routes": ray_tpu.get(controller.get_routes.remote())}
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
@@ -110,6 +144,14 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 
 def shutdown():
+    from ray_tpu.serve.http_proxy import PROXY_NAME
+
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME, namespace=_NAMESPACE)
+        ray_tpu.get(proxy.shutdown.remote())
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
     except ValueError:

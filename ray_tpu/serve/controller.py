@@ -35,9 +35,14 @@ class Replica:
                                                "reconfigure"):
             self.instance.reconfigure(user_config)
 
-    async def handle_request(self, method: str, args, kwargs):
+    async def handle_request(self, method: str, args, kwargs,
+                             context: dict | None = None):
         self.inflight += 1
         try:
+            if context and "multiplexed_model_id" in context:
+                from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+                _set_multiplexed_model_id(context["multiplexed_model_id"])
             fn = getattr(self.instance, method)
             out = fn(*args, **kwargs)
             import asyncio
@@ -64,6 +69,7 @@ class ServeController:
 
     def __init__(self):
         self.deployments: Dict[str, dict] = {}
+        self.routes: Dict[str, str] = {}   # route_prefix -> ingress deployment
         self._lock = threading.Lock()
         self._stop = False
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
@@ -87,6 +93,7 @@ class ServeController:
     def delete_deployment(self, name: str) -> bool:
         with self._lock:
             d = self.deployments.pop(name, None)
+            self.routes = {p: n for p, n in self.routes.items() if n != name}
         if d:
             for r in d["replicas"]:
                 try:
@@ -98,6 +105,14 @@ class ServeController:
     def get_replicas(self, name: str) -> List[Any]:
         d = self.deployments.get(name)
         return list(d["replicas"]) if d else []
+
+    def set_route(self, route_prefix: str, deployment: str) -> bool:
+        with self._lock:
+            self.routes[route_prefix] = deployment
+        return True
+
+    def get_routes(self) -> Dict[str, str]:
+        return dict(self.routes)
 
     def list_deployments(self) -> Dict[str, dict]:
         out = {}

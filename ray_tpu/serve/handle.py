@@ -66,11 +66,22 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str):
         self.deployment_name = deployment_name
         self._router: Optional[Router] = None
+        self._context: dict = {}
 
     def _get_router(self) -> Router:
         if self._router is None:
             self._router = Router(self.deployment_name)
         return self._router
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """Per-call options (ref: handle.options(multiplexed_model_id=...))."""
+        h = DeploymentHandle(self.deployment_name)
+        h._router = self._get_router()     # share router state
+        h._context = dict(self._context)
+        if multiplexed_model_id is not None:
+            h._context["multiplexed_model_id"] = multiplexed_model_id
+        return h
 
     def remote(self, *args, **kwargs):
         return self._call("__call__", args, kwargs)
@@ -90,7 +101,7 @@ class DeploymentHandle:
             idx, replica = router.pick()
             try:
                 ref = getattr(replica, "handle_request").remote(
-                    method, args, kwargs)
+                    method, args, kwargs, self._context or None)
                 router.done(idx)
                 return ref
             except (ray_tpu.exceptions.ActorDiedError,

@@ -1,0 +1,204 @@
+"""HTTP ingress proxy.
+
+Reference: python/ray/serve/_private/http_proxy.py:11 — per-node HTTPProxy
+actors (uvicorn/starlette ASGI) that resolve a route table pushed from the
+controller and forward requests to replicas via the router. Here the proxy
+is an actor running a stdlib ThreadingHTTPServer (no ASGI dependency); each
+handler thread forwards through a DeploymentHandle (P2C router) and maps
+Python results to HTTP responses.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+import ray_tpu
+
+PROXY_NAME = "_serve_http_proxy"
+_NAMESPACE = "serve"
+
+
+class Request:
+    """What an ingress deployment receives for an HTTP call (the moral
+    equivalent of the reference's starlette.requests.Request)."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, list],
+                 headers: Dict[str, str], body: bytes,
+                 route_prefix: str = "/"):
+        self.method = method
+        self.path = path
+        self.query_params = {k: v[0] if len(v) == 1 else v
+                             for k, v in query.items()}
+        self.headers = headers
+        self.body = body
+        self.route_prefix = route_prefix
+
+    def json(self) -> Any:
+        return json.loads(self.body.decode() or "null")
+
+    def text(self) -> str:
+        return self.body.decode()
+
+    def __repr__(self):
+        return f"Request({self.method} {self.path})"
+
+
+class Response:
+    """Explicit response wrapper (status/headers control)."""
+
+    def __init__(self, content: Any = "", status_code: int = 200,
+                 media_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        self.content = content
+        self.status_code = status_code
+        self.media_type = media_type
+        self.headers = headers or {}
+
+
+def _encode_result(result: Any) -> tuple:
+    """(status, content_type, payload_bytes)"""
+    if isinstance(result, Response):
+        status = result.status_code
+        body = result.content
+        ctype = result.media_type
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+            ctype = ctype or "application/json"
+        elif isinstance(body, str):
+            body = body.encode()
+            ctype = ctype or "text/plain; charset=utf-8"
+        elif not isinstance(body, (bytes, bytearray)):
+            body = str(body).encode()
+            ctype = ctype or "text/plain; charset=utf-8"
+        return status, ctype, bytes(body), result.headers
+    if isinstance(result, (dict, list)) or result is None:
+        return 200, "application/json", json.dumps(result).encode(), {}
+    if isinstance(result, (bytes, bytearray)):
+        return 200, "application/octet-stream", bytes(result), {}
+    if isinstance(result, str):
+        return 200, "text/plain; charset=utf-8", result.encode(), {}
+    return 200, "text/plain; charset=utf-8", str(result).encode(), {}
+
+
+@ray_tpu.remote
+class HTTPProxy:
+    """One per node in the reference (http_state.py); here one per cluster,
+    started by serve.start()."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 controller_name: str = "_serve_controller"):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._controller_name = controller_name
+        self._routes: Dict[str, str] = {}   # route_prefix -> deployment
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes_lock = threading.Lock()
+        self._refresh_routes()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self):
+                try:
+                    status, ctype, body, extra = proxy._handle(self)
+                except Exception as e:  # noqa: BLE001 — proxy must not die
+                    import traceback
+
+                    body = json.dumps({"error": str(e),
+                                       "traceback": traceback.format_exc()
+                                       }).encode()
+                    status, ctype, extra = 500, "application/json", {}
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 ctype or "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in extra.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._refresher = threading.Thread(target=self._refresh_loop,
+                                           daemon=True)
+        self._refresher.start()
+
+    # ---- routing table (ref: long-poll push of route table; here pull) ----
+
+    def _refresh_routes(self):
+        try:
+            controller = ray_tpu.get_actor(self._controller_name,
+                                           namespace=_NAMESPACE)
+            routes = ray_tpu.get(controller.get_routes.remote(), timeout=5)
+        except Exception:
+            return
+        with self._routes_lock:
+            self._routes = routes
+
+    def _refresh_loop(self):
+        while True:
+            time.sleep(1.0)
+            self._refresh_routes()
+
+    def _resolve(self, path: str) -> tuple:
+        """Longest-prefix match over route table."""
+        with self._routes_lock:
+            routes = dict(self._routes)
+        best = None
+        for prefix, name in routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm + "/") or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, name)
+        return best
+
+    # ---- request path (hot loop: parse → route → handle → encode) ----
+
+    def _handle(self, h) -> tuple:
+        parsed = urlparse(h.path)
+        match = self._resolve(parsed.path)
+        if match is None:
+            # route table may be stale (deploy raced the refresh loop)
+            self._refresh_routes()
+            match = self._resolve(parsed.path)
+        if match is None:
+            return (404, "application/json",
+                    json.dumps({"error": f"no route for {parsed.path}"
+                                }).encode(), {})
+        prefix, deployment = match
+        length = int(h.headers.get("Content-Length") or 0)
+        body = h.rfile.read(length) if length else b""
+        req = Request(h.command, parsed.path, parse_qs(parsed.query),
+                      dict(h.headers.items()), body, prefix)
+        handle = self._handles.get(deployment)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(deployment)
+            self._handles[deployment] = handle
+        ref = handle.remote(req)
+        result = ray_tpu.get(ref, timeout=60)
+        return _encode_result(result)
+
+    def ready(self) -> int:
+        return self.port
+
+    def shutdown(self):
+        self._server.shutdown()
+        return True

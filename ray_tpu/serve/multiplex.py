@@ -1,0 +1,103 @@
+"""Model multiplexing: many models per replica with LRU load/unload.
+
+Reference: python/ray/serve/multiplex.py — @serve.multiplexed caches up to
+max_num_models_per_replica models per replica keyed by the model id that the
+caller sets via handle.options(multiplexed_model_id=...); the loader is the
+decorated (async) method; serve.get_multiplexed_model_id() reads the id of
+the current request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import functools
+from collections import OrderedDict
+from typing import Callable, Optional
+
+_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Model id requested by the current call ('' if unset)."""
+    return _model_id.get()
+
+
+def _set_multiplexed_model_id(model_id: str):
+    _model_id.set(model_id or "")
+
+
+class _ModelCache:
+    def __init__(self, loader: Callable, max_models: int):
+        self.loader = loader
+        self.max_models = max_models
+        self.cache: OrderedDict = OrderedDict()
+        self.loading: dict = {}   # model_id -> Future (in-flight dedup)
+        self.lock = asyncio.Lock()
+
+    async def get(self, owner, model_id: str):
+        async with self.lock:
+            if model_id in self.cache:
+                self.cache.move_to_end(model_id)
+                return self.cache[model_id]
+            fut = self.loading.get(model_id)
+            if fut is None:
+                fut = asyncio.get_event_loop().create_future()
+                self.loading[model_id] = fut
+                is_loader = True
+            else:
+                is_loader = False
+        if not is_loader:
+            # someone else is loading this model; share their result
+            return await asyncio.shield(fut)
+        try:
+            out = self.loader(owner, model_id)
+            if asyncio.iscoroutine(out):
+                out = await out
+        except BaseException as e:
+            async with self.lock:
+                self.loading.pop(model_id, None)
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        async with self.lock:
+            self.cache[model_id] = out
+            self.cache.move_to_end(model_id)
+            self.loading.pop(model_id, None)
+            while len(self.cache) > self.max_models:
+                _, evicted = self.cache.popitem(last=False)
+                # best-effort unload hook (ref: __del__-based unload)
+                unload = getattr(evicted, "__serve_unload__", None)
+                if callable(unload):
+                    try:
+                        maybe = unload()
+                        if asyncio.iscoroutine(maybe):
+                            await maybe
+                    except Exception:
+                        pass
+        if not fut.done():
+            fut.set_result(out)
+        return out
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for the per-replica model loader method."""
+
+    def deco(loader: Callable):
+        cache_attr = f"__serve_multiplex_cache_{loader.__name__}"
+
+        @functools.wraps(loader)
+        async def wrapper(self, model_id: str):
+            cache = getattr(self, cache_attr, None)
+            if cache is None:
+                cache = _ModelCache(loader, max_num_models_per_replica)
+                setattr(self, cache_attr, cache)
+            return await cache.get(self, model_id)
+
+        return wrapper
+
+    if func is not None:
+        return deco(func)
+    return deco

@@ -26,9 +26,11 @@ from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.trainer import JaxTrainer, Result
+from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train import session
 
 __all__ = [
     "JaxTrainer", "Result", "ScalingConfig", "RunConfig", "FailureConfig",
-    "CheckpointConfig", "Checkpoint", "session",
+    "CheckpointConfig", "Checkpoint", "session", "Predictor", "JaxPredictor",
+    "BatchPredictor",
 ]

@@ -19,14 +19,20 @@ search algorithms (search/basic_variant.py grid/random), trial schedulers
     best = results.get_best_result()
 """
 
-from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
-                                 uniform)
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
+                                 RandomSearch, Searcher, TPESearcher, choice,
+                                 grid_search, loguniform, randint, uniform)
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     HyperBandScheduler, MedianStoppingRule,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
-                                report, get_trial_context)
+                                get_checkpoint, get_trial_context, report)
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
-    "get_trial_context", "grid_search", "choice", "uniform", "loguniform",
-    "randint", "ASHAScheduler", "FIFOScheduler",
+    "get_checkpoint", "get_trial_context", "grid_search", "choice",
+    "uniform", "loguniform", "randint", "ASHAScheduler", "FIFOScheduler",
+    "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
+    "ConcurrencyLimiter",
 ]

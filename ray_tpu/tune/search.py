@@ -77,6 +77,19 @@ def randint(lo, hi) -> RandInt:
     return RandInt(lo, hi)
 
 
+def sample_config(param_space: Dict[str, Any], rng: random.Random,
+                  grid_combo: Dict[str, Any] | None = None) -> Dict[str, Any]:
+    cfg = {}
+    for k, v in param_space.items():
+        if isinstance(v, GridSearch):
+            cfg[k] = (grid_combo or {}).get(k, rng.choice(v.values))
+        elif isinstance(v, Sampler):
+            cfg[k] = v.sample(rng)
+        else:
+            cfg[k] = v
+    return cfg
+
+
 def generate_variants(param_space: Dict[str, Any], num_samples: int,
                       seed: int = 0) -> List[Dict[str, Any]]:
     """Grid params cross-product; sampler params drawn per sample
@@ -98,3 +111,204 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int,
                     cfg[k] = v
             variants.append(cfg)
     return variants
+
+
+# ---- searcher API -----------------------------------------------------------
+# Reference: python/ray/tune/search/searcher.py — Searcher.suggest /
+# on_trial_complete drive ask/tell search algorithms (Optuna, HyperOpt, ...).
+# Here the algorithms are implemented natively instead of wrapping third-party
+# libraries.
+
+class Searcher:
+    """Ask/tell interface: the Tuner calls suggest() to obtain configs and
+    on_trial_complete() with the final result."""
+
+    def __init__(self, metric: str | None = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: str | None, mode: str,
+                              param_space: Dict[str, Any]) -> None:
+        if self.metric is None:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        self.param_space = param_space
+
+    def suggest(self, trial_id: str) -> Dict[str, Any] | None:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product + random sampling (ref: search/basic_variant.py)."""
+
+    def __init__(self, num_samples: int = 1, seed: int = 0):
+        super().__init__()
+        self.num_samples = num_samples
+        self.seed = seed
+        self._variants: List[Dict[str, Any]] | None = None
+        self._next = 0
+
+    def set_search_properties(self, metric, mode, param_space):
+        super().set_search_properties(metric, mode, param_space)
+        self._variants = generate_variants(param_space, self.num_samples,
+                                           self.seed)
+
+    def suggest(self, trial_id):
+        if self._variants is None or self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg
+
+
+class RandomSearch(Searcher):
+    """Pure random sampling from the space, unbounded (until num_samples
+    trials have been asked for by the controller)."""
+
+    def __init__(self, seed: int = 0):
+        super().__init__()
+        self.rng = random.Random(seed)
+
+    def suggest(self, trial_id):
+        return sample_config(self.param_space, self.rng)
+
+
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator (the algorithm behind the
+    reference's OptunaSearch/HyperOptSearch defaults, implemented directly).
+
+    Observations are split at the gamma-quantile into good/bad sets; numeric
+    params are modeled as Parzen windows (gaussian KDE centered on past
+    samples), categorical params as weighted categoricals; candidates are
+    drawn from the good model and scored by the density ratio l(x)/g(x).
+    """
+
+    def __init__(self, metric: str | None = None, mode: str = "max",
+                 n_startup_trials: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int = 0):
+        super().__init__(metric, mode)
+        self.n_startup = n_startup_trials
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._history: List[tuple[Dict[str, Any], float]] = []
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if error or not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        if self.mode == "min":
+            val = -val
+        self._history.append((result["config"], val))
+
+    # -- per-parameter density models --
+    def _split(self):
+        ordered = sorted(self._history, key=lambda cv: -cv[1])
+        n_good = max(1, int(len(ordered) * self.gamma))
+        return ordered[:n_good], ordered[n_good:]
+
+    @staticmethod
+    def _kde_logpdf(x, centers, bw):
+        import math
+
+        if not centers:
+            return 0.0
+        acc = 0.0
+        for c in centers:
+            acc += math.exp(-0.5 * ((x - c) / bw) ** 2)
+        return math.log(acc / len(centers) + 1e-12)
+
+    def _score(self, key, spec, value, good, bad):
+        import math
+
+        gvals = [c[key] for c, _ in good if key in c]
+        bvals = [c[key] for c, _ in bad if key in c]
+        if isinstance(spec, (Choice, GridSearch)):
+            values = spec.values
+            gw = (gvals.count(value) + 1) / (len(gvals) + len(values))
+            bw_ = (bvals.count(value) + 1) / (len(bvals) + len(values))
+            return math.log(gw) - math.log(bw_)
+        # numeric: bandwidth from the prior range
+        if isinstance(spec, (Uniform, LogUniform, RandInt)):
+            lo, hi = spec.lo, spec.hi
+            x = math.log(value) if isinstance(spec, LogUniform) else value
+            g_centers = [math.log(v) if isinstance(spec, LogUniform) else v
+                         for v in gvals]
+            b_centers = [math.log(v) if isinstance(spec, LogUniform) else v
+                         for v in bvals]
+            bw = max((hi - lo) / 5.0, 1e-9)
+            return (self._kde_logpdf(x, g_centers, bw)
+                    - self._kde_logpdf(x, b_centers, bw))
+        return 0.0
+
+    def _sample_from_good(self, key, spec, good):
+        """Draw from the good-set Parzen model (fall back to the prior)."""
+        gvals = [c[key] for c, _ in good if key in c]
+        if not gvals or self.rng.random() < 0.2:
+            return sample_config({key: spec}, self.rng)[key]
+        if isinstance(spec, (Choice, GridSearch)):
+            return self.rng.choice(gvals)
+        if isinstance(spec, (Uniform, LogUniform, RandInt)):
+            import math
+
+            lo, hi = spec.lo, spec.hi
+            center = self.rng.choice(gvals)
+            x = math.log(center) if isinstance(spec, LogUniform) else center
+            bw = max((hi - lo) / 5.0, 1e-9)
+            x = self.rng.gauss(x, bw)
+            x = max(lo, min(hi, x))
+            if isinstance(spec, LogUniform):
+                return math.exp(x)
+            if isinstance(spec, RandInt):
+                return int(round(max(spec.lo, min(spec.hi - 1, x))))
+            return x
+        return sample_config({key: spec}, self.rng)[key]
+
+    def suggest(self, trial_id):
+        tunable = {k: v for k, v in self.param_space.items()
+                   if isinstance(v, (Sampler, GridSearch))}
+        fixed = {k: v for k, v in self.param_space.items()
+                 if not isinstance(v, (Sampler, GridSearch))}
+        if len(self._history) < self.n_startup:
+            return {**fixed, **sample_config(tunable, self.rng)}
+        good, bad = self._split()
+        best, best_score = None, float("-inf")
+        for _ in range(self.n_candidates):
+            cand = {k: self._sample_from_good(k, v, good)
+                    for k, v in tunable.items()}
+            score = sum(self._score(k, v, cand[k], good, bad)
+                        for k, v in tunable.items())
+            if score > best_score:
+                best, best_score = cand, score
+        return {**fixed, **best}
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (ref: search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def set_search_properties(self, metric, mode, param_space):
+        super().set_search_properties(metric, mode, param_space)
+        self.searcher.set_search_properties(metric, mode, param_space)
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return "PENDING"
+        cfg = self.searcher.suggest(trial_id)
+        if isinstance(cfg, dict):
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

@@ -24,13 +24,17 @@ from ray_tpu.tune.search import generate_variants
 # ---- in-trial reporting API -------------------------------------------------
 
 class _TrialContext:
-    def __init__(self, trial_id: str, config: dict):
+    def __init__(self, trial_id: str, config: dict,
+                 start_checkpoint: Any = None):
         self.trial_id = trial_id
         self.config = config
         self.reports: List[dict] = []
         self.lock = threading.Lock()
         self.iteration = 0
         self.stop_requested = False
+        self.start_checkpoint = start_checkpoint
+        self.latest_checkpoint: Any = None
+        self.checkpoint_version = 0
 
 
 _trial_ctx: Optional[_TrialContext] = None
@@ -55,9 +59,11 @@ class TrialStopped(Exception):
     """Raised inside a trial when the scheduler has stopped it."""
 
 
-def report(metrics: Dict[str, Any]) -> None:
+def report(metrics: Dict[str, Any], checkpoint: Any = None) -> None:
     """ref: tune report / session.report — also the scheduler's stop
-    injection point: raises TrialStopped if the controller killed us."""
+    injection point: raises TrialStopped if the controller killed us.
+    `checkpoint` (any picklable payload, e.g. a params dict) enables
+    PBT exploit transfer and restore."""
     ctx = _trial_ctx
     if ctx is None:
         raise RuntimeError("tune.report called outside a trial")
@@ -67,14 +73,27 @@ def report(metrics: Dict[str, Any]) -> None:
     entry["_ts"] = time.time()
     with ctx.lock:
         ctx.reports.append(entry)
+        if checkpoint is not None:
+            ctx.latest_checkpoint = checkpoint
+            ctx.checkpoint_version += 1
     if ctx.stop_requested:
         raise TrialStopped()
 
 
+def get_checkpoint() -> Any:
+    """Checkpoint handed to this trial at start (PBT exploit or restore);
+    None on a fresh start. ref: train.get_checkpoint in function trainables."""
+    ctx = _trial_ctx
+    if ctx is None:
+        raise RuntimeError("tune.get_checkpoint called outside a trial")
+    return ctx.start_checkpoint
+
+
 @ray_tpu.remote
 class _TrialActor:
-    def __init__(self, trial_id: str, config: dict):
-        self.ctx = _TrialContext(trial_id, config)
+    def __init__(self, trial_id: str, config: dict,
+                 start_checkpoint: Any = None):
+        self.ctx = _TrialContext(trial_id, config, start_checkpoint)
         self.error: Optional[str] = None
         self.done = False
         self.final: Any = None
@@ -100,10 +119,14 @@ class _TrialActor:
         finally:
             self.done = True
 
-    def poll(self, after: int) -> dict:
+    def poll(self, after: int, ckpt_seen: int = -1) -> dict:
         with self.ctx.lock:
             new = self.ctx.reports[after:]
-        return {"reports": new, "done": self.done, "error": self.error}
+            out = {"reports": new, "done": self.done, "error": self.error,
+                   "ckpt_version": self.ctx.checkpoint_version}
+            if self.ctx.checkpoint_version > ckpt_seen >= 0:
+                out["checkpoint"] = self.ctx.latest_checkpoint
+        return out
 
     def request_stop(self):
         self.ctx.stop_requested = True
@@ -175,6 +198,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
+    search_alg: Any = None  # a tune.search.Searcher (ask/tell); None = basic variants
     seed: int = 0
     resources_per_trial: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
 
@@ -193,41 +217,82 @@ class Tuner:
         scheduler = tc.scheduler or FIFOScheduler()
         if getattr(scheduler, "metric", None) is None and hasattr(scheduler, "metric"):
             scheduler.metric = tc.metric
-        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
-        max_conc = tc.max_concurrent_trials or len(variants)
-
-        pending = [(f"trial_{i:05d}", cfg) for i, cfg in enumerate(variants)]
+        searcher = tc.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(tc.metric, tc.mode,
+                                           self.param_space)
+            total = tc.num_samples
+            pending: List = []  # searcher asked on demand
+        else:
+            variants = generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+            total = len(variants)
+            pending = [(f"trial_{i:05d}", cfg)
+                       for i, cfg in enumerate(variants)]
+        max_conc = tc.max_concurrent_trials or max(1, total)
+        # with an explicit queue the launch budget is the queue itself
+        launched = 0 if searcher is not None else total
         running: Dict[str, dict] = {}
         results: Dict[str, TrialResult] = {}
 
-        def launch(trial_id: str, cfg: dict):
+        def launch(trial_id: str, cfg: dict, start_checkpoint=None):
             actor = _TrialActor.options(
                 resources=dict(tc.resources_per_trial),
-                max_concurrency=2).remote(trial_id, cfg)
+                max_concurrency=2).remote(trial_id, cfg, start_checkpoint)
             run_ref = actor.run.remote(self.trainable)
+            prev = running.get(trial_id)
             running[trial_id] = {"actor": actor, "run_ref": run_ref,
-                                 "seen": 0,
-                                 "result": TrialResult(trial_id, cfg)}
+                                 "seen": 0, "ckpt_seen": 0,
+                                 "checkpoint": prev["checkpoint"] if prev else None,
+                                 "result": prev["result"] if prev
+                                 else TrialResult(trial_id, cfg)}
+            running[trial_id]["result"].config = cfg
+
+        def finish(tid: str, res: TrialResult, error: bool):
+            results[tid] = res
+            if searcher is not None:
+                searcher.on_trial_complete(
+                    tid, {**res.metrics, "config": res.config}, error=error)
 
         # ---- controller loop (ref: tune_controller.step:267) ----
-        while pending or running:
-            while pending and len(running) < max_conc:
-                tid, cfg = pending.pop(0)
-                launch(tid, cfg)
+        while pending or running or launched < total:
+            # fill free slots: from the explicit queue or the searcher
+            while len(running) < max_conc:
+                if pending:
+                    tid, cfg = pending.pop(0)
+                    launch(tid, cfg)
+                elif searcher is not None and launched < total:
+                    tid = f"trial_{launched:05d}"
+                    cfg = searcher.suggest(tid)
+                    if cfg is None:
+                        total = launched  # searcher exhausted
+                        break
+                    if cfg == "PENDING":
+                        break  # concurrency-limited; retry next tick
+                    launch(tid, cfg)
+                    launched += 1
+                else:
+                    break
             time.sleep(0.05)
             for tid in list(running):
                 st = running[tid]
                 try:
-                    poll = ray_tpu.get(st["actor"].poll.remote(st["seen"]),
-                                       timeout=30)
+                    poll = ray_tpu.get(
+                        st["actor"].poll.remote(st["seen"], st["ckpt_seen"]),
+                        timeout=30)
                 except Exception as e:
                     res = st["result"]
                     res.error = f"trial actor lost: {e}"
-                    results[tid] = res
                     del running[tid]
+                    finish(tid, res, error=True)
                     continue
+                if "checkpoint" in poll:
+                    st["checkpoint"] = poll["checkpoint"]
+                    st["ckpt_seen"] = poll["ckpt_version"]
                 res = st["result"]
+                exploit = None
                 for r in poll["reports"]:
+                    r = {**r, "config": res.config}
                     res.metrics_history.append(r)
                     res.metrics = r
                     decision = scheduler.on_result(tid, r)
@@ -237,16 +302,31 @@ class Tuner:
                         except Exception:
                             pass
                         res.stopped_early = True
+                    elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+                        exploit = decision
                 st["seen"] += len(poll["reports"])
+                if exploit is not None and not poll["done"]:
+                    # PBT: restart this trial from the source's checkpoint
+                    # with the explored config (ref: pbt.py _exploit).
+                    _, source_tid, new_config = exploit
+                    src = running.get(source_tid)
+                    src_ckpt = src["checkpoint"] if src else None
+                    if src_ckpt is not None:
+                        try:
+                            ray_tpu.kill(st["actor"])
+                        except Exception:
+                            pass
+                        launch(tid, new_config, start_checkpoint=src_ckpt)
+                        continue
                 if poll["done"]:
                     if poll["error"] and "TrialStopped" not in poll["error"]:
                         res.error = poll["error"]
-                    results[tid] = res
                     try:
                         ray_tpu.kill(st["actor"])
                     except Exception:
                         pass
                     del running[tid]
+                    finish(tid, res, error=bool(res.error))
         ordered = [results[tid] for tid in sorted(results)]
         self._save_experiment_state(ordered)
         return ResultGrid(ordered, tc.metric, tc.mode)

@@ -95,3 +95,151 @@ def test_trainer_dataset_ingest(ray_start_regular, tmp_path):
         datasets={"train": ds}).fit()
     assert result.ok, result.error
     assert result.metrics["total"] == sum(range(64))
+
+
+def test_groupby_aggregate(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu import data
+    from ray_tpu.data.aggregate import Count, Max, Mean, Sum
+
+    ds = data.from_numpy({
+        "k": np.array(["a", "b", "a", "c", "b", "a"]),
+        "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+    }, num_blocks=3)
+    out = ds.groupby("k").aggregate(Count(), Sum("v"), Mean("v"), Max("v"))
+    rows = {r["k"]: r for r in out.take_all()}
+    assert rows["a"]["count()"] == 3 and rows["a"]["sum(v)"] == 10.0
+    assert rows["b"]["mean(v)"] == 3.5
+    assert rows["c"]["max(v)"] == 4.0
+
+
+def test_groupby_map_groups(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu import data
+
+    ds = data.from_numpy({
+        "k": np.array([0, 1, 0, 1, 0]),
+        "v": np.array([1.0, 10.0, 2.0, 20.0, 3.0]),
+    }, num_blocks=2)
+    out = ds.groupby("k").map_groups(
+        lambda g: {"k": int(g["k"][0]), "total": float(g["v"].sum())},
+        num_partitions=3)
+    rows = {r["k"]: r["total"] for r in out.take_all()}
+    assert rows == {0: 6.0, 1: 30.0}
+
+
+def test_sort_distributed(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu import data
+
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(200).astype(np.int64)
+    ds = data.from_numpy({"x": vals}, num_blocks=5)
+    out = ds.sort("x").take_all()
+    assert [r["x"] for r in out] == sorted(vals.tolist())
+    out_desc = ds.sort("x", descending=True).take_all()
+    assert [r["x"] for r in out_desc] == sorted(vals.tolist(), reverse=True)
+
+
+def test_global_aggregates_and_columns(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu import data
+
+    ds = data.range(100, num_blocks=4)
+    assert ds.sum("id") == sum(range(100))
+    assert ds.min("id") == 0 and ds.max("id") == 99
+    assert abs(ds.mean("id") - 49.5) < 1e-9
+    ds2 = ds.add_column("sq", lambda b: b["id"] ** 2)
+    row = ds2.sort("id").take(1)[0]
+    assert row["sq"] == 0
+    assert ds2.select_columns(["sq"]).schema() == ["sq"]
+    assert ds2.drop_columns(["sq"]).schema() == ["id"]
+
+
+def test_preprocessors_scalers_and_chain(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu import data
+    from ray_tpu.data.preprocessors import (Chain, Concatenator,
+                                            LabelEncoder, MinMaxScaler,
+                                            StandardScaler)
+
+    ds = data.from_numpy({
+        "a": np.array([1.0, 2.0, 3.0, 4.0]),
+        "b": np.array([10.0, 20.0, 30.0, 40.0]),
+        "label": np.array(["cat", "dog", "cat", "bird"]),
+    }, num_blocks=2)
+
+    scaler = StandardScaler(["a"])
+    out = scaler.fit_transform(ds).take_all()
+    col = np.array([r["a"] for r in out])
+    assert abs(col.mean()) < 1e-9
+
+    chain = Chain(MinMaxScaler(["a", "b"]), LabelEncoder("label"),
+                  Concatenator(["a", "b"]))
+    out2 = chain.fit_transform(ds).take_all()
+    assert out2[0]["features"].shape == (2,)
+    labels = sorted(r["label"] for r in out2)
+    assert labels == [0, 1, 1, 2]
+
+
+def test_batch_predictor(ray_start_regular, tmp_path):
+    import numpy as np
+
+    from ray_tpu import data
+    from ray_tpu.train import BatchPredictor, Checkpoint, JaxPredictor
+
+    # a "model": y = x @ w with w=2*I
+    w = np.eye(3, dtype=np.float32) * 2
+    ckpt = Checkpoint.from_state({"params": {"w": w}}, str(tmp_path / "ck"))
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    ds = data.from_numpy(
+        {"features": np.arange(30, dtype=np.float32).reshape(10, 3)},
+        num_blocks=2)
+    bp = BatchPredictor(ckpt, JaxPredictor, apply_fn=apply_fn)
+    out = bp.predict(ds, num_replicas=2)
+    rows = out.take_all()
+    assert len(rows) == 10
+    np.testing.assert_allclose(
+        np.stack([r["predictions"] for r in rows]),
+        np.arange(30, dtype=np.float32).reshape(10, 3) * 2)
+
+
+def test_zip_unaligned_blocks(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu import data
+
+    a = data.from_numpy({"x": np.arange(10)}, num_blocks=3)
+    b = data.from_numpy({"y": np.arange(10) * 10}, num_blocks=4)
+    rows = a.zip(b).take_all()
+    assert len(rows) == 10
+    for r in rows:
+        assert r["y"] == r["x"] * 10
+
+
+def test_std_large_mean_stability(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu import data
+
+    rng = np.random.default_rng(0)
+    vals = 1e8 + rng.normal(0, 0.5, size=1000)
+    ds = data.from_numpy({"v": vals}, num_blocks=4)
+    got = ds.std("v")
+    want = float(np.std(vals, ddof=1))
+    assert abs(got - want) / want < 1e-6, (got, want)
+
+
+def test_sort_all_empty(ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.range(10, num_blocks=2).filter(lambda r: False)
+    assert ds.sort("id").take_all() == []

@@ -101,3 +101,80 @@ def test_llm_server_deployment(ray_start_regular):
     assert all(len(o["tokens"]) == 4 for o in outs)
     assert all(o["ttft_s"] is not None for o in outs)
     serve.shutdown()
+
+
+def test_http_proxy_end_to_end(ray_start_regular):
+    """Real HTTP requests through the ingress proxy to a deployment."""
+    import json as _json
+    import urllib.request
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, request):
+            if request.method == "POST":
+                data = request.json()
+                return {"doubled": data["x"] * 2}
+            return {"path": request.path,
+                    "q": request.query_params.get("name", "")}
+
+    port = serve.start(http_port=0)
+    serve.run(Echo.bind(), route_prefix="/echo")
+    base = f"http://127.0.0.1:{port}"
+
+    with urllib.request.urlopen(f"{base}/echo?name=tpu", timeout=30) as r:
+        assert r.status == 200
+        body = _json.loads(r.read())
+        assert body == {"path": "/echo", "q": "tpu"}
+
+    req = urllib.request.Request(
+        f"{base}/echo", data=_json.dumps({"x": 21}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert _json.loads(r.read()) == {"doubled": 42}
+
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen(f"{base}/nope", timeout=30)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    st = serve.status()
+    assert st["routes"] == {"/echo": "Echo"}
+    serve.shutdown()
+
+
+def test_multiplexed_model_loading(ray_start_regular):
+    """LRU model cache per replica keyed by multiplexed model id."""
+
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id[1:])}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return {"y": x * model["scale"], "model": model["id"],
+                    "loads": list(self.loads)}
+
+    handle = serve.run(MultiModel.bind())
+    out1 = ray_tpu.get(
+        handle.options(multiplexed_model_id="m3").remote(5))
+    assert out1 == {"y": 15, "model": "m3", "loads": ["m3"]}
+    # same model again: no reload
+    out2 = ray_tpu.get(
+        handle.options(multiplexed_model_id="m3").remote(2))
+    assert out2["loads"] == ["m3"]
+    # two more models: m3 evicted (LRU, capacity 2)
+    ray_tpu.get(handle.options(multiplexed_model_id="m4").remote(1))
+    ray_tpu.get(handle.options(multiplexed_model_id="m5").remote(1))
+    out3 = ray_tpu.get(
+        handle.options(multiplexed_model_id="m3").remote(1))
+    assert out3["loads"] == ["m3", "m4", "m5", "m3"]
+    serve.shutdown()
