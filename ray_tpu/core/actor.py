@@ -101,6 +101,12 @@ class ActorClass:
         return ActorHandle(actor_id, _method_meta(self._cls),
                            o.get("max_task_retries", 0))
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor DAG node (ref: ray.dag ClassNode)."""
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def __call__(self, *a, **k):
         raise TypeError(
             f"Actor class '{self._cls.__name__}' cannot be instantiated "

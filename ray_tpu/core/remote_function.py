@@ -51,6 +51,12 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node (ref: ray.dag — DAGNode via .bind())."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function '{getattr(self._fn, '__name__', '?')}' cannot be "

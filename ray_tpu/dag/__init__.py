@@ -1,0 +1,15 @@
+"""Lazy task/actor DAGs: `.bind()` builds, `.execute()` runs.
+
+Reference: python/ray/dag/ (DAGNode at dag/dag_node.py:23, InputNode,
+function_node.py, class_node.py). Used by Serve deployment graphs the same
+way the reference's pre-compiled-graph era DAGs are.
+"""
+
+from ray_tpu.dag.dag_node import (ClassMethodNode, ClassNode, DAGNode,
+                                  FunctionNode, InputAttributeNode, InputNode,
+                                  MultiOutputNode)
+
+__all__ = [
+    "DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode", "InputNode",
+    "InputAttributeNode", "MultiOutputNode",
+]

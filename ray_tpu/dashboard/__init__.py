@@ -1,0 +1,11 @@
+"""Dashboard: HTTP head server exposing cluster state, metrics, and logs.
+
+Reference: dashboard/head.py (aiohttp head server) + dashboard/modules/
+(state, metrics, jobs, logs). The React client is out of scope; every view
+is JSON (the reference's dashboard modules are JSON APIs under the UI too),
+plus a Prometheus /metrics endpoint and a minimal HTML overview.
+"""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

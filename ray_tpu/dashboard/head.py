@@ -1,0 +1,280 @@
+"""Dashboard head server.
+
+Reference: dashboard/head.py — an aiohttp server on the head node serving
+pluggable modules (dashboard/utils.py:40 DashboardHeadModule); we fold the
+state/metrics/jobs/logs modules into route groups on one app. Talks to the
+GCS directly over the RPC layer (no driver Runtime required), like the
+reference head's GcsClient.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Any, Optional, Tuple
+
+from ray_tpu.core.rpc import ClientPool
+
+Address = Tuple[str, int]
+
+
+def _jsonable(v: Any):
+    """Best-effort conversion of dataclasses / ids / bytes for JSON."""
+    if isinstance(v, dict):
+        return {_jsonable_key(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, bytes):
+        return v.hex()
+    if hasattr(v, "hex") and not isinstance(v, (int, float)):
+        try:
+            return v.hex()
+        except TypeError:
+            pass
+    if hasattr(v, "__dataclass_fields__"):
+        return {f: _jsonable(getattr(v, f)) for f in v.__dataclass_fields__}
+    if hasattr(v, "quantities"):
+        return _jsonable(v.quantities)
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+def _jsonable_key(k: Any):
+    if isinstance(k, bytes):
+        return k.hex()
+    if isinstance(k, (str, int, float, bool)):
+        return k
+    return str(k)
+
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title></head>
+<body style="font-family: monospace">
+<h2>ray_tpu dashboard</h2>
+<ul>
+<li><a href="/api/v0/summary">cluster summary</a></li>
+<li><a href="/api/v0/nodes">nodes</a></li>
+<li><a href="/api/v0/actors">actors</a></li>
+<li><a href="/api/v0/tasks">task events</a></li>
+<li><a href="/api/v0/jobs">jobs</a></li>
+<li><a href="/api/v0/node_stats">per-node stats</a></li>
+<li><a href="/metrics">prometheus metrics</a></li>
+<li><a href="/api/v0/logs">log files</a></li>
+</ul>
+</body></html>"""
+
+
+class DashboardHead:
+    def __init__(self, gcs_addr: Address, session_dir: str = "",
+                 host: str = "127.0.0.1", port: int = 8265):
+        self.gcs_addr = tuple(gcs_addr)
+        self.session_dir = session_dir
+        self.host = host
+        self.port = port
+        self.pool = ClientPool()
+        self._runner = None
+        self._site = None
+
+    async def _gcs(self, method: str, **kw):
+        return await self.pool.get(self.gcs_addr).call(method, timeout=10.0, **kw)
+
+    # ------------------------------------------------------------- handlers
+
+    async def _h_index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    def _json(self, payload):
+        from aiohttp import web
+
+        return web.json_response(_jsonable(payload))
+
+    async def _h_nodes(self, request):
+        nodes = await self._gcs("get_nodes")
+        return self._json([{
+            "node_id": n.node_id.hex(), "alive": n.alive,
+            "address": list(n.nodelet_addr),
+            "resources": n.resources_total.quantities,
+            "labels": n.labels, "store_name": n.store_name,
+        } for n in nodes])
+
+    async def _h_actors(self, request):
+        return self._json(await self._gcs("list_actors"))
+
+    async def _h_tasks(self, request):
+        limit = int(request.query.get("limit", 1000))
+        return self._json(await self._gcs("list_task_events", limit=limit))
+
+    async def _h_jobs(self, request):
+        return self._json(await self._gcs("list_jobs"))
+
+    async def _h_summary(self, request):
+        nodes = await self._gcs("get_nodes")
+        actors = await self._gcs("list_actors")
+        total: dict = {}
+        for n in nodes:
+            if not n.alive:
+                continue
+            for k, v in n.resources_total.quantities.items():
+                total[k] = total.get(k, 0) + v
+        return self._json({
+            "time": time.time(),
+            "nodes_alive": sum(1 for n in nodes if n.alive),
+            "nodes_dead": sum(1 for n in nodes if not n.alive),
+            "total_resources": total,
+            "actors_total": len(actors),
+            "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+        })
+
+    async def _h_node_stats(self, request):
+        nodes = [n for n in await self._gcs("get_nodes") if n.alive]
+
+        async def one(n):
+            try:
+                return await self.pool.get(tuple(n.nodelet_addr)).call(
+                    "node_stats", timeout=5.0)
+            except Exception as e:  # noqa: BLE001 — per-node best effort
+                return {"error": str(e)}
+
+        stats = await asyncio.gather(*(one(n) for n in nodes))
+        return self._json({n.node_id.hex(): st
+                           for n, st in zip(nodes, stats)})
+
+    async def _h_metrics(self, request):
+        """Prometheus exposition (ref: dashboard/modules/metrics/ +
+        metrics_agent.py exposition)."""
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import render_prometheus
+
+        lines = []
+        try:
+            keys = await self._gcs("kv_keys", ns="metrics")
+            for key in keys:
+                raw = await self._gcs("kv_get", ns="metrics", key=key)
+                if raw is None:
+                    continue
+                lines.extend(render_prometheus(key.decode(), json.loads(raw)))
+        except Exception as e:  # noqa: BLE001
+            lines.append(f"# metrics collection error: {e}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    async def _h_logs(self, request):
+        """List/serve session log files (ref: dashboard log module)."""
+        from aiohttp import web
+
+        logs_dir = os.path.join(self.session_dir, "logs")
+        name = request.query.get("file")
+        if not os.path.isdir(logs_dir):
+            return self._json([])
+        if name is None:
+            return self._json(sorted(os.listdir(logs_dir)))
+        path = os.path.realpath(os.path.join(logs_dir, name))
+        root = os.path.realpath(logs_dir)
+        if os.path.commonpath([path, root]) != root \
+                or not os.path.isfile(path):
+            return web.Response(status=404, text="no such log")
+        tail = int(request.query.get("tail", 1000))
+        with open(path, "r", errors="replace") as f:
+            lines = f.readlines()[-tail:]
+        return web.Response(text="".join(lines), content_type="text/plain")
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> Address:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._h_index)
+        app.router.add_get("/api/v0/nodes", self._h_nodes)
+        app.router.add_get("/api/v0/actors", self._h_actors)
+        app.router.add_get("/api/v0/tasks", self._h_tasks)
+        app.router.add_get("/api/v0/jobs", self._h_jobs)
+        app.router.add_get("/api/v0/summary", self._h_summary)
+        app.router.add_get("/api/v0/node_stats", self._h_node_stats)
+        app.router.add_get("/metrics", self._h_metrics)
+        app.router.add_get("/api/v0/logs", self._h_logs)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        # resolve ephemeral port
+        for sock in self._site._server.sockets:  # noqa: SLF001
+            self.port = sock.getsockname()[1]
+            break
+        return (self.host, self.port)
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def start_dashboard(gcs_addr: Address, session_dir: str = "",
+                    host: str = "127.0.0.1", port: int = 8265,
+                    loop: Optional[asyncio.AbstractEventLoop] = None
+                    ) -> "DashboardHead":
+    """Start a dashboard on an existing asyncio loop (or a fresh thread).
+
+    Blocks until the server is bound (so `head.port` is resolved even for
+    port=0) and re-raises any startup failure in the caller."""
+    head = DashboardHead(gcs_addr, session_dir, host, port)
+    if loop is not None:
+        fut = asyncio.run_coroutine_threadsafe(head.start(), loop)
+        fut.result(timeout=10)
+        return head
+    import threading
+
+    started = threading.Event()
+    failure: list = []
+
+    def _run():
+        lp = asyncio.new_event_loop()
+        asyncio.set_event_loop(lp)
+        try:
+            lp.run_until_complete(head.start())
+        except BaseException as e:  # noqa: BLE001 — re-raised in caller
+            failure.append(e)
+            started.set()
+            return
+        started.set()
+        lp.run_forever()
+
+    t = threading.Thread(target=_run, daemon=True, name="raytpu-dashboard")
+    t.start()
+    if not started.wait(10):
+        raise TimeoutError("dashboard did not start within 10s")
+    if failure:
+        raise failure[0]
+    return head
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gcs-address", required=True, help="host:port")
+    ap.add_argument("--session-dir", default="")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8265)
+    args = ap.parse_args()
+    host, port = args.gcs_address.rsplit(":", 1)
+
+    async def _serve():
+        head = DashboardHead((host, int(port)), args.session_dir, args.host,
+                             args.port)
+        addr = await head.start()
+        print(json.dumps({"dashboard_url": f"http://{addr[0]}:{addr[1]}"}),
+              flush=True)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,20 @@ class Histogram(_Metric):
         self._flush("histogram")
 
 
+def render_prometheus(name: str, data: dict) -> List[str]:
+    """Exposition lines for one metric's KV payload (shared by
+    prometheus_text and the dashboard /metrics endpoint)."""
+    lines = []
+    if data.get("description"):
+        lines.append(f"# HELP {name} {data['description']}")
+    lines.append(f"# TYPE {name} {data.get('kind', 'gauge')}")
+    for s in data.get("series", []):
+        tags = ",".join(f'{k}="{v}"' for k, v in sorted(s["tags"].items()))
+        label = f"{{{tags}}}" if tags else ""
+        lines.append(f"{name}{label} {s['value']}")
+    return lines
+
+
 def prometheus_text() -> str:
     """Render all reported metrics in Prometheus exposition format
     (ref: metrics_agent.py Prometheus export)."""
@@ -107,13 +121,5 @@ def prometheus_text() -> str:
         raw = runtime.kv_get("metrics", key)
         if raw is None:
             continue
-        data = json.loads(raw)
-        name = key.decode()
-        if data.get("description"):
-            lines.append(f"# HELP {name} {data['description']}")
-        lines.append(f"# TYPE {name} {data['kind']}")
-        for s in data["series"]:
-            tags = ",".join(f'{k}="{v}"' for k, v in s["tags"].items())
-            label = f"{{{tags}}}" if tags else ""
-            lines.append(f"{name}{label} {s['value']}")
+        lines.extend(render_prometheus(key.decode(), json.loads(raw)))
     return "\n".join(lines) + "\n"

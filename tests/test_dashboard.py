@@ -1,0 +1,79 @@
+"""Dashboard head server: state JSON endpoints, Prometheus metrics, logs.
+
+Reference: dashboard/head.py + modules (state_head.py, metrics, logs).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def dash():
+    ray_tpu.init(num_cpus=2)
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dashboard import start_dashboard
+
+    rt = get_runtime()
+    head = start_dashboard(rt.gcs_addr, session_dir="", port=0)
+    base = f"http://{head.host}:{head.port}"
+    yield base
+    ray_tpu.shutdown()
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+        return r.status, r.headers.get_content_type(), body
+
+
+def test_index_and_summary(dash):
+    status, ctype, body = _get(dash + "/")
+    assert status == 200 and ctype == "text/html"
+
+    status, ctype, body = _get(dash + "/api/v0/summary")
+    assert status == 200
+    s = json.loads(body)
+    assert s["nodes_alive"] >= 1
+    assert s["total_resources"].get("CPU", 0) >= 2
+
+
+def test_nodes_actors_tasks(dash):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    _, _, body = _get(dash + "/api/v0/nodes")
+    nodes = json.loads(body)
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+
+    _, _, body = _get(dash + "/api/v0/actors")
+    actors = json.loads(body)
+    assert any(x["state"] == "ALIVE" for x in actors)
+
+    _, _, body = _get(dash + "/api/v0/tasks?limit=10")
+    assert isinstance(json.loads(body), list)
+
+
+def test_node_stats_and_metrics(dash):
+    from ray_tpu.util.metrics import Counter
+
+    c = Counter("dash_test_counter", description="test counter")
+    c.inc(3.0)
+
+    _, _, body = _get(dash + "/api/v0/node_stats")
+    stats = json.loads(body)
+    assert len(stats) >= 1
+    first = next(iter(stats.values()))
+    assert "available" in first
+
+    status, ctype, body = _get(dash + "/metrics")
+    assert status == 200 and ctype == "text/plain"
+    assert "dash_test_counter" in body

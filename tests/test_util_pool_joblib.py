@@ -1,0 +1,123 @@
+"""multiprocessing.Pool shim + joblib backend + DAG API.
+
+Reference: python/ray/util/multiprocessing/, python/ray/util/joblib/,
+python/ray/dag/.
+"""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+class TestPool:
+    def test_map(self, cluster):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+
+    def test_apply_async_and_starmap(self, cluster):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            r = p.apply_async(_add, (2, 3))
+            assert r.get(timeout=30) == 5
+            assert r.successful()
+            assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+    def test_imap_unordered(self, cluster):
+        from ray_tpu.util.multiprocessing import Pool
+
+        with Pool(processes=2) as p:
+            out = sorted(p.imap_unordered(_sq, range(8), chunksize=2))
+            assert out == [x * x for x in range(8)]
+
+    def test_error_propagates(self, cluster):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def boom(_):
+            raise ValueError("nope")
+
+        with Pool(processes=2) as p:
+            r = p.map_async(boom, [1])
+            with pytest.raises(Exception):
+                r.get(timeout=30)
+
+
+class TestJoblib:
+    def test_parallel_backend(self, cluster):
+        import joblib
+
+        from ray_tpu.util.joblib_backend import register_ray
+
+        register_ray()
+        with joblib.parallel_backend("ray_tpu", n_jobs=2):
+            out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+        assert out == [x * x for x in range(6)]
+
+
+class TestDag:
+    def test_function_dag_diamond(self, cluster):
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        def double(x):
+            return 2 * x
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        with InputNode() as inp:
+            a = double.bind(inp)
+            b = double.bind(a)
+            c = add.bind(a, b)
+        assert ray_tpu.get(c.execute(3)) == 6 + 12
+        assert ray_tpu.get(c.execute(5)) == 10 + 20
+
+    def test_actor_dag(self, cluster):
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start):
+                self.v = start
+
+            def add(self, x):
+                self.v += x
+                return self.v
+
+        with InputNode() as inp:
+            node = Counter.bind(10)
+            out = node.add.bind(inp)
+        assert ray_tpu.get(out.execute(1)) == 11
+        assert ray_tpu.get(out.execute(2)) == 13  # same actor, stateful
+
+    def test_multi_output_and_input_attr(self, cluster):
+        from ray_tpu.dag import InputNode, MultiOutputNode
+
+        @ray_tpu.remote
+        def pick(x):
+            return x
+
+        with InputNode() as inp:
+            a = pick.bind(inp["a"])
+            b = pick.bind(inp["b"])
+            dag = MultiOutputNode([a, b])
+        ra, rb = dag.execute(a=1, b=2)
+        assert ray_tpu.get(ra) == 1 and ray_tpu.get(rb) == 2
