@@ -51,7 +51,10 @@ def main():
     on_tpu = dev.platform == "tpu"
     dt = jnp.bfloat16 if on_tpu else jnp.float32
 
-    cfg = llama.PRESETS["debug-125m"].replace(dtype=dt, remat=True)
+    # Pallas flash attention (fwd + FlashAttention-2 bwd kernels) on TPU;
+    # XLA attention off-TPU where Pallas runs interpreted (slow).
+    cfg = llama.PRESETS["debug-125m"].replace(
+        dtype=dt, remat=True, attn_impl="flash" if on_tpu else "xla")
     B, S = (8, 1024) if on_tpu else (2, 128)
     mesh = build_mesh(MeshSpec(dp=-1), devices=jax.devices()[:1]) \
         if on_tpu else build_mesh(MeshSpec(dp=-1))

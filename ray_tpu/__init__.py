@@ -63,6 +63,7 @@ def init(address: Optional[str] = None, *,
          resources: Optional[Dict[str, float]] = None,
          namespace: str = "default",
          ignore_reinit_error: bool = False,
+         runtime_env: Optional[Dict[str, Any]] = None,
          _system_config: Optional[Dict[str, Any]] = None) -> dict:
     """Start (or connect to) a ray_tpu cluster.
 
@@ -119,6 +120,13 @@ def init(address: Optional[str] = None, *,
                               mode="driver")
         _rt.set_runtime(runtime)
         runtime.start()
+        if runtime_env:
+            # Job-level env: merged into every submitted task/actor spec
+            # that doesn't set its own (ref: job_config runtime_env).
+            from ray_tpu import runtime_env as _renv
+
+            runtime.default_runtime_env = _renv.resolve_uris(runtime,
+                                                             runtime_env)
         runtime.gcs_call("add_job", job_id=job_id, driver_addr=runtime.address.addr,
                          meta={"namespace": namespace, "pid": os.getpid()})
         if cfg.log_to_driver:

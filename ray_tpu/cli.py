@@ -142,6 +142,27 @@ def cmd_serve(args):
         print("serve shut down")
 
 
+def cmd_dashboard(args):
+    """Serve the HTTP dashboard against a running cluster
+    (ref: dashboard/head.py)."""
+    import asyncio
+
+    from ray_tpu.dashboard import DashboardHead
+
+    h, p = args.address.rsplit(":", 1)
+
+    async def _serve():
+        head = DashboardHead((h, int(p)), session_dir=args.session_dir,
+                             host=args.http_host, port=args.http_port)
+        addr = await head.start()
+        print(json.dumps({"dashboard_url": f"http://{addr[0]}:{addr[1]}"}),
+              flush=True)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_serve())
+
+
 def main():
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -172,6 +193,13 @@ def main():
     s.add_argument("--limit", type=int, default=10000)
     s.add_argument("--output", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("dashboard", help="run the HTTP dashboard")
+    s.add_argument("--address", required=True, help="GCS host:port")
+    s.add_argument("--session-dir", default="")
+    s.add_argument("--http-host", default="127.0.0.1")
+    s.add_argument("--http-port", type=int, default=8265)
+    s.set_defaults(fn=cmd_dashboard)
 
     s = sub.add_parser("serve", help="serve deploy/status/shutdown")
     s.add_argument("serve_cmd", choices=["deploy", "status", "shutdown"])

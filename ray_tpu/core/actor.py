@@ -19,7 +19,7 @@ from ray_tpu.core import runtime as rt
 _ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "memory", "resources", "name", "namespace",
     "max_restarts", "max_task_retries", "max_concurrency",
-    "scheduling_strategy", "lifetime",
+    "scheduling_strategy", "lifetime", "runtime_env",
 }
 
 
@@ -97,7 +97,8 @@ class ActorClass:
                                runtime.cfg.actor_max_restarts_default),
             max_concurrency=o.get("max_concurrency", 1),
             scheduling=o.get("scheduling_strategy") or SchedulingStrategy(),
-            lifetime=o.get("lifetime"))
+            lifetime=o.get("lifetime"),
+            runtime_env=o.get("runtime_env"))
         return ActorHandle(actor_id, _method_meta(self._cls),
                            o.get("max_task_retries", 0))
 

@@ -25,6 +25,11 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     object_transfer_chunk_bytes: int = 8 * 1024**2  # ref: 64MiB gRPC chunks; we
                                                     # default smaller for 1-host
+    # --- object spilling (ref: local_object_manager.h:41 + external_storage) -
+    object_spill_enabled: bool = True
+    object_spill_threshold: float = 0.8          # spill when usage crosses this
+    object_spill_low_water: float = 0.5          # ...down to this fraction
+    object_spill_dir: str = ""                   # default: <session>/spill
     # --- scheduler / raylet -------------------------------------------------
     worker_lease_timeout_s: float = 30.0
     worker_pool_prestart: int = 0

@@ -30,8 +30,12 @@ def _spawn_with_ready(cmd, session_dir: str, log_name: str,
     os.makedirs(logdir, exist_ok=True)
     out = open(os.path.join(logdir, log_name + ".out"), "ab")
     err = open(os.path.join(logdir, log_name + ".err"), "ab")
+    # pass_fds (implies close_fds=True): only the ready-fd crosses into the
+    # daemon — inheriting everything leaks the parent's stdout/stderr pipes
+    # into long-lived daemons, which keeps `pytest | tail`-style consumers
+    # blocked on EOF forever after the parent exits.
     proc = subprocess.Popen(cmd + ["--ready-fd", str(wfd)],
-                            stdout=out, stderr=err, close_fds=False,
+                            stdout=out, stderr=err, pass_fds=(wfd,),
                             start_new_session=True)
     out.close(); err.close()
     os.close(wfd)

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.common import Address, NodeInfo, ResourceSet, TaskSpec
 from ray_tpu.core.config import Config
+from ray_tpu.core.external_storage import FilesystemStorage
 from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.core.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
@@ -84,6 +85,14 @@ class Nodelet:
         self.pool = ClientPool()
         self.server = RpcServer(self)
         self.store: Optional[SharedMemoryStore] = None
+        self.spill: Optional[FilesystemStorage] = None
+        # Primary copies pinned on behalf of owners (ref: raylet pins
+        # primaries, local_object_manager spills them under pressure). The
+        # nodelet may spill-then-unpin these autonomously: the disk copy
+        # keeps the availability guarantee.
+        self.primary_pins: set = set()
+        self._spilled_then_dropped = 0
+        self._restored = 0
         self._hb_seq = 0
         self._stopping = False
 
@@ -102,10 +111,16 @@ class Nodelet:
         r = await gcs.call("register_node", info=info,
                            timeout=self.cfg.rpc_connect_timeout_s)
         assert r["ok"]
+        if self.cfg.object_spill_enabled:
+            spill_dir = self.cfg.object_spill_dir or os.path.join(
+                self.session_dir, "spill", self.node_id.hex()[:12])
+            self.spill = FilesystemStorage(spill_dir)
         loop = asyncio.get_running_loop()
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._reap_loop())
         loop.create_task(self._log_loop())
+        if self.spill is not None:
+            loop.create_task(self._spill_loop())
         for _ in range(self.cfg.worker_pool_prestart):
             loop.create_task(self._start_worker())
         return addr
@@ -427,14 +442,122 @@ class Nodelet:
         return {"ok": True}
 
     # ----------------------------------------------------------- object plane
+    #
+    # Spilling (ref: local_object_manager.h:41 spill-under-pressure +
+    # external_storage.py FileSystemStorage): a background pass copies sealed
+    # LRU objects to disk *before* native eviction could drop them, then
+    # frees the unpinned ones. Pinned primaries are only dropped after their
+    # owner releases the pin (rpc_free_space reply → owner unpins → native
+    # LRU eviction reclaims, with the disk copy as the durable tier).
 
-    async def rpc_has_object(self, oid: ObjectID) -> bool:
-        return self.store.contains(oid)
+    def _spill_usage(self) -> float:
+        cap = self.store.capacity() or 1
+        return self.store.bytes_in_use() / cap
 
-    async def rpc_read_chunk(self, oid: ObjectID, offset: int, size: int) -> Optional[dict]:
-        """Serve one chunk of a local sealed object (ref: HandlePush chunks)."""
+    async def _spill_loop(self):
+        period = 0.2
+        while not self._stopping:
+            try:
+                if self._spill_usage() > self.cfg.object_spill_threshold:
+                    low = int(self.cfg.object_spill_low_water
+                              * self.store.capacity())
+                    target = self.store.bytes_in_use() - low
+                    await self._spill_pass(target)
+            except Exception:
+                logger.exception("spill pass failed")
+            await asyncio.sleep(period)
+
+    async def _spill_pass(self, target_bytes: int) -> dict:
+        """Spill sealed LRU objects until ~target_bytes are freed.
+
+        An object is freeable once its only pin is the nodelet's own
+        primary pin (reader pins block freeing but not the disk copy).
+        Freeing uses the atomic evict-if-unpinned native primitive so a
+        reader pinning after our snapshot is never invalidated."""
+        freed = 0
+        for oid, size, _pins in self.store.list_objects():
+            if freed >= target_bytes:
+                break
+            if not self.spill.contains(oid):
+                view = self.store.get_view(oid)
+                if view is None:
+                    continue
+                try:
+                    data = bytes(view)
+                finally:
+                    del view
+                    self.store.release(oid)
+                await asyncio.to_thread(self.spill.spill, oid, data)
+            our_pin = 1 if oid in self.primary_pins else 0
+            if self.store.evict_if_unpinned(oid, max_pins=our_pin):
+                self.primary_pins.discard(oid)
+                self._spilled_then_dropped += 1
+                freed += size
+        return {"freed": freed}
+
+    async def rpc_free_space(self, need_bytes: int, **_compat) -> dict:
+        """Make room for an incoming allocation (owner-side put retry path)."""
+        if self.spill is None:
+            return {"ok": False, "freed": 0, "error": "spilling disabled"}
+        r = await self._spill_pass(need_bytes)
+        r["ok"] = True
+        return r
+
+    async def rpc_pin_object(self, oid: ObjectID) -> dict:
+        """Pin a primary copy on behalf of its owner (ref: raylet
+        PinObjectIDs). Idempotent; the pin lives until delete or spill."""
+        if oid in self.primary_pins:
+            return {"ok": True}
         view = self.store.get_view(oid)
         if view is None:
+            # Already only on disk (or gone); the spill tier is the pin.
+            ok = self.spill is not None and self.spill.contains(oid)
+            return {"ok": ok}
+        del view  # keep the refcount from ts_get; release happens at unpin
+        self.primary_pins.add(oid)
+        return {"ok": True}
+
+    async def _restore_local(self, oid: ObjectID) -> bool:
+        """Disk → shm (ref: restore_spilled_object). False if absent/full."""
+        if self.spill is None or not self.spill.contains(oid):
+            return False
+        if self.store.contains(oid):
+            return True
+        data = await asyncio.to_thread(self.spill.restore, oid)
+        if data is None:
+            return False
+        view = self.store.create_view(oid, len(data))
+        if view is None:
+            # Make room (other spilled-but-resident objects can go).
+            await self._spill_pass(len(data))
+            view = self.store.create_view(oid, len(data))
+        if view is None:
+            return self.store.contains(oid)
+        try:
+            view[:] = data
+        except BaseException:
+            del view
+            self.store.abort(oid)
+            raise
+        del view
+        self.store.seal(oid)
+        self._restored += 1
+        return True
+
+    async def rpc_has_object(self, oid: ObjectID) -> bool:
+        return self.store.contains(oid) or (
+            self.spill is not None and self.spill.contains(oid))
+
+    async def rpc_read_chunk(self, oid: ObjectID, offset: int, size: int) -> Optional[dict]:
+        """Serve one chunk of a local sealed object (ref: HandlePush chunks).
+        Falls back to the spill tier, streaming straight off disk."""
+        view = self.store.get_view(oid)
+        if view is None:
+            if self.spill is not None:
+                r = await asyncio.to_thread(self.spill.read_range, oid,
+                                            offset, size)
+                if r is not None:
+                    return {"total": r[0], "data": r[1]}
             return None
         try:
             total = len(view)
@@ -449,6 +572,10 @@ class Nodelet:
         (ref: PullManager pull_manager.h:52 + ObjectManager::Push)."""
         if self.store.contains(oid):
             return {"ok": True}
+        if await self._restore_local(oid):
+            return {"ok": True}
+        if tuple(source) == (self.server.host, self.server.port):
+            return {"ok": False, "error": "object not at source"}
         src = self.pool.get(tuple(source))
         chunk = self.cfg.object_transfer_chunk_bytes
         try:
@@ -459,6 +586,9 @@ class Nodelet:
             return {"ok": False, "error": "object not at source"}
         total = first["total"]
         view = self.store.create_view(oid, total)
+        if view is None and self.spill is not None:
+            await self._spill_pass(total)
+            view = self.store.create_view(oid, total)
         if view is None:
             if self.store.contains(oid):
                 return {"ok": True}
@@ -483,7 +613,12 @@ class Nodelet:
 
     async def rpc_delete_objects(self, oids: List[ObjectID]) -> dict:
         for oid in oids:
+            if oid in self.primary_pins:
+                self.store.release(oid)
+                self.primary_pins.discard(oid)
             self.store.delete(oid)
+            if self.spill is not None:
+                self.spill.delete(oid)
         return {"ok": True}
 
     # ------------------------------------------------------------------- misc
@@ -503,6 +638,11 @@ class Nodelet:
             "store_bytes": self.store.bytes_in_use(),
             "store_objects": self.store.num_objects(),
             "store_evictions": self.store.num_evictions(),
+            "spilled_objects": (self.spill.num_spilled()
+                                if self.spill is not None else 0),
+            "spilled_bytes": (self.spill.bytes_spilled()
+                              if self.spill is not None else 0),
+            "restored_objects": self._restored,
             "pending_leases": len(self.pending),
         }
 

@@ -67,6 +67,14 @@ class _Lib:
                 lib.ts_num_objects.argtypes = [ctypes.c_void_p]
                 lib.ts_num_evictions.restype = ctypes.c_uint64
                 lib.ts_num_evictions.argtypes = [ctypes.c_void_p]
+                lib.ts_list.restype = ctypes.c_uint32
+                lib.ts_list.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_int64), ctypes.c_uint32]
+                lib.ts_evict.restype = ctypes.c_int
+                lib.ts_evict.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int64]
                 cls._lib = lib
             return cls._lib
 
@@ -191,6 +199,29 @@ class SharedMemoryStore:
             if copy:
                 del view
                 self.release(oid)
+
+    def evict_if_unpinned(self, oid: ObjectID, max_pins: int = 0) -> bool:
+        """Atomically free a sealed object iff refcount <= max_pins (the
+        caller's own pins). The safe spill-eviction primitive: decision and
+        free happen under one native lock."""
+        if not self._h:
+            return False
+        return self._lib.ts_evict(self._h, oid.binary(), max_pins) == 1
+
+    def list_objects(self, max_entries: int = 4096
+                     ) -> List[tuple]:
+        """Sealed objects LRU-first as (ObjectID, size, pin_count) — the
+        spill-candidate order (ref: eviction_policy.h LRU cache)."""
+        if not self._h:
+            return []
+        ids = (ctypes.c_uint8 * (20 * max_entries))()
+        sizes = (ctypes.c_uint64 * max_entries)()
+        pins = (ctypes.c_int64 * max_entries)()
+        n = self._lib.ts_list(
+            self._h, ids, sizes, pins, max_entries)
+        raw = bytes(ids)
+        return [(ObjectID(raw[i * 20:(i + 1) * 20]), int(sizes[i]),
+                 int(pins[i])) for i in range(n)]
 
     # -- stats ---------------------------------------------------------------
 

@@ -16,6 +16,7 @@ from ray_tpu.core import runtime as rt
 _TASK_OPTIONS = {
     "num_cpus", "num_tpus", "memory", "resources", "num_returns",
     "max_retries", "retry_exceptions", "scheduling_strategy", "name",
+    "runtime_env",
 }
 
 
@@ -46,7 +47,8 @@ class RemoteFunction:
             resources=resources,
             max_retries=o.get("max_retries"),
             retry_exceptions=o.get("retry_exceptions", False),
-            scheduling=o.get("scheduling_strategy") or SchedulingStrategy())
+            scheduling=o.get("scheduling_strategy") or SchedulingStrategy(),
+            runtime_env=o.get("runtime_env"))
         if o.get("num_returns", 1) == 1:
             return refs[0]
         return refs

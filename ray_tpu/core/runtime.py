@@ -125,6 +125,10 @@ class Runtime:
                                      self._notify_owner)
         self.directory: Dict[ObjectID, _ObjectEntry] = {}
         self._dir_lock = threading.Lock()
+        # Read pins backing zero-copy values handed to the user; held until
+        # the owning ref is GC'd. Spill safety against these pins lives in
+        # the native store (ts_evict frees only when refcount is the
+        # nodelet's own pin).
         self._pinned: Dict[ObjectID, memoryview] = {}
 
         # submission state, per scheduling class
@@ -149,6 +153,8 @@ class Runtime:
         self._put_lock = threading.Lock()
         self._fn_cache: Dict[bytes, Any] = {}
         self._exported: Set[bytes] = set()
+        self.default_runtime_env: Optional[dict] = None  # job-level env
+        self._renv_cache: Dict[str, dict] = {}
         self._task_events: List[dict] = []
         self.address: Optional[RuntimeAddress] = None
         self._started = False
@@ -224,13 +230,18 @@ class Runtime:
 
     # ---------------------------------------------------------------- objects
 
-    def set_exec_context(self, task_id: TaskID):
+    def set_exec_context(self, task_id: TaskID,
+                         runtime_env: Optional[dict] = None):
         self._exec_ctx.task_id = task_id
         self._exec_ctx.put_index = 0
+        # Nested submissions from inside this task inherit its env
+        # (ref: runtime_env inheritance parent → child).
+        self._exec_ctx.runtime_env = runtime_env
 
     def clear_exec_context(self):
         self._exec_ctx.task_id = None
         self._exec_ctx.put_index = 0
+        self._exec_ctx.runtime_env = None
 
     def get_current_task_id(self) -> TaskID:
         tid = getattr(self._exec_ctx, "task_id", None)
@@ -265,7 +276,7 @@ class Runtime:
             e.inline = bytes(packed)
             self.memory_store.put(oid, value)
         else:
-            view = self.store.create_view(oid, size)
+            view = self._create_view_with_spill(oid, size)
             if view is None:
                 if not self.store.contains(oid):
                     from ray_tpu.core.status import ObjectStoreFullError
@@ -276,13 +287,47 @@ class Runtime:
                 del view
                 self.store.seal(oid)
             if _pin:
-                v = self.store.get_view(oid)   # pin primary copy
-                if v is not None:
-                    self._pinned[oid] = v
+                self._pin_primary(oid)
             e.locations.add(self.nodelet_addr)
         e.state = "ready"
         e.event.set()
         return ObjectRef(oid, self.address)
+
+    def _pin_primary(self, oid: ObjectID):
+        """Ask the nodelet to pin the primary copy (ref: raylet
+        PinObjectIDs). A guard pin bridges the seal→nodelet-pin window so
+        eviction cannot race the handoff."""
+        guard = self.store.get_view(oid)
+        try:
+            self._run(self.pool.get(self.nodelet_addr).call(
+                "pin_object", oid=oid, timeout=30.0))
+        except (ConnectionLost, RemoteError, OSError) as e:
+            logger.warning("pin_object(%s) failed: %s", oid.hex()[:12], e)
+        finally:
+            if guard is not None:
+                del guard
+                self.store.release(oid)
+
+    def _create_view_with_spill(self, oid: ObjectID, size: int):
+        """create_view, asking the nodelet to spill for room on failure
+        (ref: local_object_manager spill-on-pressure — the nodelet may
+        spill even pinned primaries, since it owns those pins)."""
+        view = self.store.create_view(oid, size)
+        if view is not None or self.store.contains(oid):
+            return view
+        for _ in range(3):
+            try:
+                r = self._run(self.pool.get(self.nodelet_addr).call(
+                    "free_space", need_bytes=size, timeout=60.0))
+            except (ConnectionLost, RemoteError, OSError) as e:
+                logger.warning("free_space failed: %s", e)
+                return None
+            view = self.store.create_view(oid, size)
+            if view is not None or self.store.contains(oid):
+                return view
+            if r.get("freed", 0) <= 0:
+                return None  # nothing left to spill; store genuinely full
+        return None
 
     def _free_object(self, oid: ObjectID):
         """All refs gone: drop every copy (ref: ReferenceCounter on-zero →
@@ -420,9 +465,9 @@ class Runtime:
             v = self._read_local(oid)
             if v is not _MISSING:
                 return v
-        for loc in locations:
-            if tuple(loc) == self.nodelet_addr:
-                continue
+        # A "local" location may live only in the nodelet's spill tier;
+        # pull_object restores it from disk (ref: restore_spilled_object).
+        for loc in sorted(locations, key=lambda a: tuple(a) != self.nodelet_addr):
             try:
                 r = self._run(self.pool.get(self.nodelet_addr).call(
                     "pull_object", oid=oid, source=tuple(loc), timeout=120.0))
@@ -518,12 +563,37 @@ class Runtime:
 
     # ------------------------------------------------------- task submission
 
+    def resolve_runtime_env(self, env: Optional[dict]) -> Optional[dict]:
+        """Validate + upload local dirs → package URIs, memoized by spec
+        (ref: runtime_env packaging at task submission)."""
+        from ray_tpu import runtime_env as renv
+
+        base = getattr(self._exec_ctx, "runtime_env", None) \
+            or self.default_runtime_env
+        if env is not None and base:
+            # Task-level overrides job-level per field; env_vars deep-merge
+            # with task keys winning (ref: runtime_env merge semantics).
+            merged = {**base, **env}
+            if "env_vars" in base or "env_vars" in env:
+                merged["env_vars"] = {**base.get("env_vars", {}),
+                                      **env.get("env_vars", {})}
+        else:
+            merged = env if env is not None else base
+        if not merged:
+            return None
+        key = renv.to_json(merged)
+        cached = self._renv_cache.get(key)
+        if cached is None:
+            cached = self._renv_cache[key] = renv.resolve_uris(self, merged)
+        return cached
+
     def submit_task(self, fn: Callable, args: tuple, kwargs: dict, *,
                     name: str = "", num_returns: int = 1,
                     resources: Optional[ResourceSet] = None,
                     max_retries: Optional[int] = None,
                     retry_exceptions: bool = False,
-                    scheduling: Optional[SchedulingStrategy] = None) -> List[ObjectRef]:
+                    scheduling: Optional[SchedulingStrategy] = None,
+                    runtime_env: Optional[dict] = None) -> List[ObjectRef]:
         """ref: CoreWorker::SubmitTask core_worker.cc:1855."""
         fid = self.export_function(fn)
         task_id = TaskID(os_urandom4() + b"\x00" * 8 + self.job_id.binary())
@@ -535,7 +605,8 @@ class Runtime:
             resources=resources or ResourceSet({"CPU": 1.0}),
             owner=self.address, job_id=self.job_id, max_retries=mr,
             retry_exceptions=retry_exceptions,
-            scheduling=scheduling or SchedulingStrategy())
+            scheduling=scheduling or SchedulingStrategy(),
+            runtime_env=self.resolve_runtime_env(runtime_env))
         refs = self._register_returns(spec, arg_ids)
         self._submit_spec(spec, retries_left=mr)
         return refs
@@ -757,7 +828,8 @@ class Runtime:
                      resources: Optional[ResourceSet] = None,
                      max_restarts: int = 0, max_concurrency: int = 1,
                      scheduling: Optional[SchedulingStrategy] = None,
-                     lifetime: Optional[str] = None) -> ActorID:
+                     lifetime: Optional[str] = None,
+                     runtime_env: Optional[dict] = None) -> ActorID:
         """ref: CoreWorker::CreateActor core_worker.cc:1922 → GCS RegisterActor."""
         fid = self.export_function(cls)
         actor_id = ActorID.of(self.job_id)
@@ -771,7 +843,8 @@ class Runtime:
             scheduling=scheduling or SchedulingStrategy(),
             is_actor_creation=True, actor_id=actor_id,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
-            actor_name=name, namespace=namespace)
+            actor_name=name, namespace=namespace,
+            runtime_env=self.resolve_runtime_env(runtime_env))
         self.refs.on_task_submitted(arg_ids)
         r = self.gcs_call("register_actor", spec=spec)
         if not r.get("ok"):

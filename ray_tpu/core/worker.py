@@ -96,14 +96,12 @@ class Worker:
                 returns.append(("inline", bytes(packed)))
             else:
                 store = self.runtime.store
-                view = store.create_view(rid, size)
+                view = self.runtime._create_view_with_spill(rid, size)
                 if view is not None:
                     serialization.write_to(view, meta, bufs)
                     del view
                     store.seal(rid)
-                    pin = store.get_view(rid)  # pin the primary copy
-                    if pin is not None:
-                        self.runtime._pinned.setdefault(rid, pin)
+                    self.runtime._pin_primary(rid)  # nodelet owns the pin
                 elif not store.contains(rid):
                     raise MemoryError(f"object store full storing return {i}")
                 returns.append(("store", self.runtime.nodelet_addr))
@@ -112,12 +110,19 @@ class Worker:
     def _execute(self, spec: TaskSpec, fn=None) -> TaskResult:
         """Runs on an executor thread — NEVER on the asyncio loop: it blocks
         on GCS KV fetches and dependency gets, which are loop-driven."""
-        self.runtime.set_exec_context(spec.task_id)
+        from ray_tpu.runtime_env import TaskEnvContext
+
+        # Actor methods inherit the actor's creation env (ref: actor-level
+        # runtime_env applies to all its tasks).
+        env = spec.runtime_env or (self.actor_spec.runtime_env
+                                   if self.actor_spec else None)
+        self.runtime.set_exec_context(spec.task_id, runtime_env=env)
         try:
-            if fn is None:
-                fn = self.runtime.load_function(spec.func_id)
-            args, kwargs = self._resolve_args(spec)
-            value = fn(*args, **kwargs)
+            with TaskEnvContext(self.runtime, spec.runtime_env):
+                if fn is None:
+                    fn = self.runtime.load_function(spec.func_id)
+                args, kwargs = self._resolve_args(spec)
+                value = fn(*args, **kwargs)
             return self._package_returns(spec, value)
         except BaseException as e:
             tb = traceback.format_exc()
@@ -141,8 +146,15 @@ class Worker:
         self._async_sem = asyncio.Semaphore(max(1, spec.max_concurrency))
 
         def _ctor():
-            self.runtime.set_exec_context(spec.task_id)
+            from ray_tpu.runtime_env import TaskEnvContext
+
+            self.runtime.set_exec_context(spec.task_id,
+                                          runtime_env=spec.runtime_env)
             try:
+                # The actor owns this worker: its runtime env persists for
+                # the actor's lifetime (entered, never exited — ref: actors
+                # run in env-dedicated workers).
+                TaskEnvContext(self.runtime, spec.runtime_env).__enter__()
                 cls = self.runtime.load_function(spec.func_id)
                 args, kwargs = self._resolve_args(spec)
                 self.actor_instance = cls(*args, **kwargs)

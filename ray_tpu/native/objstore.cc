@@ -43,6 +43,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <new>
 
 namespace {
@@ -498,6 +499,79 @@ int ts_delete(void* sp, const uint8_t* id) {
   h->num_objects--;
   unlock(h);
   return 0;
+}
+
+// Enumerate sealed objects, least-recently-used first (the spill candidate
+// order). Fills ids_out (max*20 bytes), sizes_out and pins_out (max each);
+// returns the count written. Snapshot under the lock; callers must tolerate
+// entries vanishing (eviction) between the snapshot and any follow-up call.
+uint32_t ts_list(void* sp, uint8_t* ids_out, uint64_t* sizes_out,
+                 int64_t* pins_out, uint32_t max) {
+  Store* s = reinterpret_cast<Store*>(sp);
+  Header* h = s->hdr;
+  // Snapshot under the lock (O(n) copy), sort outside it — keeps the
+  // cross-process critical section short even with many sealed objects.
+  struct Item {
+    uint8_t id[kIdLen];
+    uint64_t size;
+    int64_t pins;
+    uint64_t tick;
+  };
+  if (lock(h) != 0) return 0;
+  uint32_t total = h->num_objects;
+  Item* items = new (std::nothrow) Item[total ? total : 1];
+  if (items == nullptr) {
+    unlock(h);
+    return 0;
+  }
+  Entry* tab = entries(h);
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < h->max_objects && n < total; i++) {
+    Entry* e = &tab[i];
+    if (e->state != kSealed) continue;
+    memcpy(items[n].id, e->id, kIdLen);
+    items[n].size = e->size;
+    items[n].pins = e->refcount;
+    items[n].tick = e->lru_tick;
+    n++;
+  }
+  unlock(h);
+  std::sort(items, items + n,
+            [](const Item& a, const Item& b) { return a.tick < b.tick; });
+  if (n > max) n = max;
+  for (uint32_t i = 0; i < n; i++) {
+    memcpy(ids_out + (uint64_t)i * kIdLen, items[i].id, kIdLen);
+    sizes_out[i] = items[i].size;
+    pins_out[i] = items[i].pins;
+  }
+  delete[] items;
+  return n;
+}
+
+// Atomically free a sealed object iff its current pin count is <= max_pins
+// (the caller's own pins). Returns 1 freed, 0 still pinned by readers,
+// -1 absent/unsealed. This is the safe spill-eviction primitive: the
+// decision and the free happen under one lock, so a reader pinning between
+// a stale snapshot and the delete can never be invalidated (the bug class
+// ts_delete's refcount-ignoring contract would allow).
+int ts_evict(void* sp, const uint8_t* id, int64_t max_pins) {
+  Store* s = reinterpret_cast<Store*>(sp);
+  Header* h = s->hdr;
+  if (lock(h) != 0) return -1;
+  Entry* e = find_slot(h, id, false);
+  if (e == nullptr || e->state != kSealed) {
+    unlock(h);
+    return -1;
+  }
+  if (e->refcount > max_pins) {
+    unlock(h);
+    return 0;
+  }
+  heap_free(h, e->offset, e->capacity);
+  e->state = kFree;
+  h->num_objects--;
+  unlock(h);
+  return 1;
 }
 
 uint64_t ts_bytes_in_use(void* sp) {

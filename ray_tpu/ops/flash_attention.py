@@ -5,9 +5,11 @@ VMEM while K/V stream through in chunks with the online-softmax recurrence —
 O(S) memory instead of O(S^2), and the QK^T / PV matmuls hit the MXU at
 [block_q x head_dim] x [head_dim x block_k] granularity.
 
-Backward: memory-bounded chunked recompute in plain JAX (lax.scan over k
-chunks) using the saved log-sum-exp from the forward kernel. XLA fuses this
-into tight loops; a full Pallas backward is a later-round optimization.
+Backward: full Pallas two-kernel backward (FlashAttention-2 style): a dQ
+pass gridded over q-blocks and a dK/dV pass gridded over k-blocks, both
+recomputing probabilities from the saved log-sum-exp so nothing O(S^2) is
+ever materialized. A chunked-recompute JAX fallback remains selectable via
+BACKWARD_IMPL for debugging.
 
 GQA is handled in the kernel via the k/v index maps (kv_head = head // group)
 — no KV broadcast materialization.
@@ -69,7 +71,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     o, m, l = jax.lax.fori_loop(0, num_k, body, (o0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (o / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    # Lane-broadcast (Mosaic wants last-dim 128 blocks; official TPU flash
+    # kernel stores l/m the same way).
+    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l), (block_q, 128))
 
 
 def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
@@ -99,21 +103,183 @@ def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, 128), jnp.float32),
         ],
         interpret=_use_interpret(),
     )(qt, kt, vt)
     return out.transpose(0, 2, 1, 3), lse
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref, *,
+                   block_k: int, scale: float, causal: bool):
+    """One instance per (b, h, q-block): stream K/V, accumulate dQ
+    (FlashAttention-2 backward, dQ pass). delta = rowsum(o * dO) is
+    computed in-kernel from the resident blocks."""
+    block_q, D = q_ref.shape[2], q_ref.shape[3]
+    T = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    g = g_ref[0, 0].astype(jnp.float32)
+    o = o_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, 0:1]
+    delta = jnp.sum(o * g, axis=-1, keepdims=True)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(ki, dq):
+        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        num_k = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    else:
+        num_k = T // block_k
+    dq = jax.lax.fori_loop(0, num_k,
+                           body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
+                     dk_ref, dv_ref, *, block_q: int, scale: float,
+                     causal: bool):
+    """Grid (b, h, k-block, q-block): the dk/dv output block is constant in
+    the (minor) q axis, so Mosaic keeps it resident and this accumulates
+    across sequential q steps — O(block) VMEM at any sequence length
+    (FlashAttention-2 backward, dK/dV pass). dK/dV land per-query-head;
+    the wrapper sums over GQA groups."""
+    block_k, D = k_ref.shape[2], k_ref.shape[3]
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _zero():
+        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+
+    # Causal: a q-block strictly above the diagonal contributes nothing.
+    run = True
+    if causal:
+        run = (qi + 1) * block_q > ki * block_k
+
+    @pl.when(run)
+    def _accumulate():
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)
+        g = g_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = jnp.sum(o * g, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [bq, bk]
+        dv_ref[0, 0] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # p^T @ g
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_ref[0, 0] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # ds^T @ q
+
+
+def _flash_pallas_bwd(res, g, *, causal: bool, block_q: int, block_k: int):
+    """Full Pallas backward: two kernels (dQ; dK/dV), GQA group-sum on the
+    dK/dV results (FlashAttention-2, Dao 2023)."""
+    q, k, v, out, lse = res
+    B, S, H, D = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    groups = H // KV
+    scale = D ** -0.5
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    gt = g.transpose(0, 2, 1, 3)
+    ot = out.transpose(0, 2, 1, 3)
+
+    q_blk = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, T, D),
+                           lambda b, h, i, g_=groups: (b, h // g_, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale,
+                          causal=causal),
+        grid=(B, H, S // block_q),
+        in_specs=[
+            q_blk,
+            kv_spec,
+            kv_spec,
+            q_blk,
+            q_blk,
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=_use_interpret(),
+    )(qt, kt, vt, gt, ot, lse)
+
+    q_stream = pl.BlockSpec((1, 1, block_q, D),
+                            lambda b, h, i, j: (b, h, j, 0))
+    kv_blk = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, i, j, g_=groups: (b, h // g_, i, 0))
+    dkv_spec = pl.BlockSpec((1, 1, block_k, D),
+                            lambda b, h, i, j: (b, h, i, 0))
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, block_q=block_q, scale=scale,
+                          causal=causal),
+        grid=(B, H, T // block_k, S // block_q),
+        in_specs=[
+            q_stream,
+            kv_blk,
+            kv_blk,
+            q_stream,
+            q_stream,
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[dkv_spec, dkv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T, D), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(qt, kt, vt, gt, ot, lse)
+
+    # GQA: sum per-query-head contributions into each kv head.
+    dk = dk_h.reshape(B, KV, groups, T, D).sum(2).transpose(0, 2, 1, 3)
+    dv = dv_h.reshape(B, KV, groups, T, D).sum(2).transpose(0, 2, 1, 3)
+    return dq.transpose(0, 2, 1, 3), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _reference_chunked_bwd(res, g, *, causal: bool, chunk: int):
     """Recompute-based backward, chunked over the key axis to stay O(S*chunk)
     in memory. Uses the forward's lse so probabilities are exact."""
     q, k, v, out, lse = res
+    lse = lse[..., 0]                                  # drop lane broadcast
     B, S, H, D = q.shape
     T, KV = k.shape[1], k.shape[2]
     groups = H // KV
@@ -175,7 +341,13 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
+BACKWARD_IMPL = "pallas"   # "pallas" | "chunked" (recompute fallback)
+
+
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+    if BACKWARD_IMPL == "pallas":
+        return _flash_pallas_bwd(res, g, causal=causal, block_q=block_q,
+                                 block_k=block_k)
     return _reference_chunked_bwd(res, g, causal=causal, chunk=block_k * 4)
 
 

@@ -14,6 +14,31 @@ the policy update is one jitted SPMD step on the TPU mesh.
         metrics = trainer.train()
 """
 
+from ray_tpu.rl.core import Algorithm, ReplayActor, ReplayBuffer
+from ray_tpu.rl.dqn import DQNConfig, DQNTrainer
+from ray_tpu.rl.impala import ImpalaConfig, ImpalaTrainer
 from ray_tpu.rl.ppo import PPOConfig, PPOTrainer
+from ray_tpu.rl.sac import SACConfig, SACTrainer
 
-__all__ = ["PPOConfig", "PPOTrainer"]
+_REGISTRY = {
+    "PPO": (PPOConfig, PPOTrainer),
+    "DQN": (DQNConfig, DQNTrainer),
+    "SAC": (SACConfig, SACTrainer),
+    "IMPALA": (ImpalaConfig, ImpalaTrainer),
+}
+
+
+def get_algorithm(name: str):
+    """(ConfigCls, TrainerCls) by name (ref: rllib registry.py
+    get_algorithm_class)."""
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown algorithm {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+__all__ = [
+    "Algorithm", "ReplayBuffer", "ReplayActor", "get_algorithm",
+    "PPOConfig", "PPOTrainer", "DQNConfig", "DQNTrainer",
+    "SACConfig", "SACTrainer", "ImpalaConfig", "ImpalaTrainer",
+]

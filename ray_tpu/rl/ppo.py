@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rl.core import EnvSampler
 
 
 # --- policy (pure JAX, shared by learner and rollout workers) ----------------
@@ -50,28 +51,14 @@ def policy_forward(params, obs):
 
 
 @ray_tpu.remote
-class RolloutWorker:
+class RolloutWorker(EnvSampler):
     """Samples env steps with the latest policy weights
     (ref: rollout_worker.py; sampler.py)."""
 
-    def __init__(self, env_name: str, seed: int = 0,
-                 env_config: Optional[dict] = None):
-        import os
-
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        import gymnasium as gym
-
-        self.env = gym.make(env_name, **(env_config or {}))
-        self.seed = seed
-        self.obs, _ = self.env.reset(seed=seed)
-        self.episode_return = 0.0
-        self.completed_returns: List[float] = []
-
     def sample(self, params_host, num_steps: int) -> Dict[str, np.ndarray]:
-        import jax
         import jax.numpy as jnp
 
-        rng = np.random.default_rng(self.seed + len(self.completed_returns))
+        rng = np.random.default_rng(self.seed + len(self.completed))
         obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = \
             [], [], [], [], [], []
         for _ in range(num_steps):
@@ -82,20 +69,13 @@ class RolloutWorker:
             p = p / p.sum()
             action = int(rng.choice(len(p), p=p))
             logp = float(np.log(p[action] + 1e-9))
-            nobs, rew, term, trunc, _ = self.env.step(action)
-            done = bool(term or trunc)
-            obs_buf.append(np.asarray(self.obs, np.float32))
+            prev, rew, term, trunc, _nobs = self.step_env(action)
+            obs_buf.append(np.asarray(prev, np.float32))
             act_buf.append(action)
-            rew_buf.append(float(rew))
-            done_buf.append(done)
+            rew_buf.append(rew)
+            done_buf.append(term or trunc)
             logp_buf.append(logp)
             val_buf.append(float(np.asarray(value)[0]))
-            self.episode_return += float(rew)
-            if done:
-                self.completed_returns.append(self.episode_return)
-                self.episode_return = 0.0
-                nobs, _ = self.env.reset()
-            self.obs = nobs
         # bootstrap value for the final state
         _, last_v = policy_forward(params_host, jnp.asarray(self.obs)[None])
         return {
@@ -107,11 +87,6 @@ class RolloutWorker:
             "values": np.asarray(val_buf, np.float32),
             "last_value": float(np.asarray(last_v)[0]),
         }
-
-    def episode_stats(self) -> Dict[str, float]:
-        rets = self.completed_returns[-20:]
-        return {"episodes": len(self.completed_returns),
-                "mean_return": float(np.mean(rets)) if rets else 0.0}
 
 
 # --- GAE + learner -----------------------------------------------------------

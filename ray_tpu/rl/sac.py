@@ -1,0 +1,262 @@
+"""SAC: squashed-Gaussian actor, twin Q critics, auto-tuned temperature.
+
+Reference: rllib/algorithms/sac/ (twin_q, target entropy = -|A|, tau
+polyak updates). Continuous control; sampling on CPU actors, jitted update.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import (Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
+                             mlp_init, probe_env_spec)
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def init_sac_nets(key, obs_dim: int, act_dim: int, hidden: int):
+    import jax
+
+    ks = jax.random.split(key, 4)
+    actor = {"torso": mlp_init(ks[0], [obs_dim, hidden, hidden]),
+             "head": mlp_init(ks[3], [hidden, 2 * act_dim], out_scale=0.01)}
+    q1 = mlp_init(ks[1], [obs_dim + act_dim, hidden, hidden, 1])
+    q2 = mlp_init(ks[2], [obs_dim + act_dim, hidden, hidden, 1])
+    return {"actor": actor, "q1": q1, "q2": q2}
+
+
+def actor_dist(actor, obs):
+    import jax.numpy as jnp
+
+    h = mlp_forward(actor["torso"], obs, final_activation=True)
+    out = mlp_forward(actor["head"], h)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    return mu, log_std
+
+
+def sample_action(actor, obs, key, act_high: float):
+    """tanh-squashed reparameterized sample + log-prob."""
+    import jax
+    import jax.numpy as jnp
+
+    mu, log_std = actor_dist(actor, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    a = jnp.tanh(pre)
+    # log prob with tanh correction (SAC appendix C)
+    logp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+    logp -= jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+    return a * act_high, logp
+
+
+def q_value(q, obs, act):
+    import jax.numpy as jnp
+
+    return mlp_forward(q, jnp.concatenate([obs, act], -1))[..., 0]
+
+
+@ray_tpu.remote
+class _SACWorker(EnvSampler):
+    def __init__(self, env_name: str, seed: int,
+                 env_config: Optional[dict] = None):
+        super().__init__(env_name, seed, env_config)
+        self.act_high = float(np.asarray(
+            self.env.action_space.high).reshape(-1)[0])
+
+    def sample(self, actor, num_steps: int, random_actions: bool):
+        import jax
+        import jax.numpy as jnp
+
+        obs_b, act_b, rew_b, done_b, nobs_b = [], [], [], [], []
+        for _ in range(num_steps):
+            if random_actions:
+                action = self.env.action_space.sample()
+            else:
+                key = jax.random.PRNGKey(self.seed * 100003 + self.steps)
+                a, _ = sample_action(actor, jnp.asarray(self.obs)[None], key,
+                                     self.act_high)
+                action = np.asarray(a)[0]
+            prev, rew, term, _trunc, nobs = self.step_env(action)
+            obs_b.append(np.asarray(prev, np.float32))
+            act_b.append(np.asarray(action, np.float32))
+            rew_b.append(rew)
+            done_b.append(float(term))
+            nobs_b.append(np.asarray(nobs, np.float32))
+        return {"obs": np.stack(obs_b), "actions": np.stack(act_b),
+                "rewards": np.asarray(rew_b, np.float32),
+                "dones": np.asarray(done_b, np.float32),
+                "next_obs": np.stack(nobs_b)}
+
+
+@dataclass
+class SACConfig:
+    env: str = "Pendulum-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 1
+    rollout_fragment_length: int = 100
+    replay_capacity: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    updates_per_iter: int = 32
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    hidden: int = 128
+    seed: int = 0
+
+
+class SACTrainer(Algorithm):
+    """ref: rllib/algorithms/sac/sac.py training_step."""
+
+    def _setup(self, cfg: SACConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        obs_dim, n_actions, act_dim, act_high = probe_env_spec(
+            cfg.env, cfg.env_config)
+        assert act_dim is not None, "SAC needs a continuous action space"
+        self.act_high = act_high or 1.0
+        self.nets = init_sac_nets(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                  act_dim, cfg.hidden)
+        self.target_q = jax.tree_util.tree_map(
+            lambda x: x, {"q1": self.nets["q1"], "q2": self.nets["q2"]})
+        self.log_alpha = jnp.zeros(())
+        self.target_entropy = -float(act_dim)
+
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.alpha_opt = optax.adam(cfg.alpha_lr)
+        self.actor_os = self.actor_opt.init(self.nets["actor"])
+        self.critic_os = self.critic_opt.init(
+            {"q1": self.nets["q1"], "q2": self.nets["q2"]})
+        self.alpha_os = self.alpha_opt.init(self.log_alpha)
+
+        self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
+        self.workers = [
+            _SACWorker.options(num_cpus=0.5).remote(
+                cfg.env, cfg.seed + i * 1000, cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        act_high = self.act_high
+        target_entropy = self.target_entropy
+
+        def update(nets, target_q, log_alpha, actor_os, critic_os, alpha_os,
+                   mb, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # --- critics
+            def critic_loss(qs):
+                a_next, logp_next = sample_action(nets["actor"],
+                                                  mb["next_obs"], k1, act_high)
+                tq = jnp.minimum(
+                    q_value(target_q["q1"], mb["next_obs"], a_next),
+                    q_value(target_q["q2"], mb["next_obs"], a_next))
+                backup = mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * (
+                    tq - alpha * logp_next)
+                backup = jax.lax.stop_gradient(backup)
+                l1 = jnp.square(q_value(qs["q1"], mb["obs"], mb["actions"])
+                                - backup).mean()
+                l2 = jnp.square(q_value(qs["q2"], mb["obs"], mb["actions"])
+                                - backup).mean()
+                return l1 + l2
+
+            qs = {"q1": nets["q1"], "q2": nets["q2"]}
+            closs, cgrads = jax.value_and_grad(critic_loss)(qs)
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os, qs)
+            qs = optax.apply_updates(qs, cupd)
+            nets = {**nets, "q1": qs["q1"], "q2": qs["q2"]}
+
+            # --- actor
+            def actor_loss(actor):
+                a, logp = sample_action(actor, mb["obs"], k2, act_high)
+                q = jnp.minimum(q_value(nets["q1"], mb["obs"], a),
+                                q_value(nets["q2"], mb["obs"], a))
+                return (alpha * logp - q).mean(), logp
+
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(nets["actor"])
+            aupd, actor_os = self.actor_opt.update(agrads, actor_os,
+                                                   nets["actor"])
+            nets = {**nets,
+                    "actor": optax.apply_updates(nets["actor"], aupd)}
+
+            # --- temperature
+            def alpha_loss(la):
+                return -(jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + target_entropy)).mean()
+
+            lloss, lgrad = jax.value_and_grad(alpha_loss)(log_alpha)
+            lupd, alpha_os = self.alpha_opt.update(lgrad, alpha_os, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, lupd)
+
+            # --- polyak target update
+            target_q = jax.tree_util.tree_map(
+                lambda t, s: (1 - cfg.tau) * t + cfg.tau * s, target_q,
+                {"q1": nets["q1"], "q2": nets["q2"]})
+            aux = {"critic_loss": closs, "actor_loss": aloss,
+                   "alpha": jnp.exp(log_alpha)}
+            return nets, target_q, log_alpha, actor_os, critic_os, alpha_os, aux
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        actor_host = jax.device_get(self.nets["actor"])
+        warmup = self.timesteps < cfg.learning_starts
+        refs = [w.sample.remote(actor_host, cfg.rollout_fragment_length,
+                                warmup)
+                for w in self.workers]
+        for b in ray_tpu.get(refs):
+            self.buffer.add_batch(b)
+            self.timesteps += len(b["rewards"])
+
+        aux = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for u in range(cfg.updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                key = jax.random.PRNGKey(self.iteration * 10007 + u)
+                (self.nets, self.target_q, self.log_alpha, self.actor_os,
+                 self.critic_os, self.alpha_os, aux) = self._update(
+                    self.nets, self.target_q, self.log_alpha, self.actor_os,
+                    self.critic_os, self.alpha_os, mb, key)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "buffer_size": len(self.buffer),
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
+        self.target_q = jax.tree_util.tree_map(
+            lambda x: x, {"q1": self.nets["q1"], "q2": self.nets["q2"]})

@@ -1,0 +1,112 @@
+"""RL algorithm zoo: DQN, SAC, IMPALA mechanics.
+
+Reference test model: rllib per-algorithm tests
+(rllib/algorithms/*/tests/) assert a few training iterations run, losses
+are finite, and save/restore round-trips — not learning curves (those are
+release "learning tests").
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _tree_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return all(np.allclose(x, y) for x, y in zip(la, lb))
+
+
+def test_registry():
+    from ray_tpu.rl import get_algorithm
+
+    cfg_cls, trainer_cls = get_algorithm("DQN")
+    assert cfg_cls.__name__ == "DQNConfig"
+    with pytest.raises(ValueError):
+        get_algorithm("NOPE")
+
+
+def test_replay_buffer_roundtrip():
+    from ray_tpu.rl import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=100, seed=0)
+    for i in range(3):
+        buf.add_batch({"obs": np.full((40, 4), i, np.float32),
+                       "act": np.full((40,), i, np.int32)})
+    assert len(buf) == 100  # 120 added, FIFO wrap
+    s = buf.sample(32)
+    assert s["obs"].shape == (32, 4) and s["act"].shape == (32,)
+
+
+def test_dqn_trains(cluster):
+    from ray_tpu.rl import DQNConfig, DQNTrainer
+
+    cfg = DQNConfig(num_rollout_workers=2, rollout_fragment_length=100,
+                    learning_starts=150, updates_per_iter=8,
+                    target_network_update_freq=200)
+    t = DQNTrainer(cfg)
+    try:
+        import jax
+
+        w_init = jax.device_get(t.get_weights())
+        r1 = t.train()
+        r2 = t.train()
+        assert r2["timesteps_total"] == 400
+        assert r2["num_updates"] == 8
+        assert np.isfinite(r2["loss"])
+        assert 0 < r2["epsilon"] <= 1
+        # weights must have moved once updates started
+        assert not _tree_equal(t.get_weights(), w_init)
+
+        ckpt = t.save()
+        w0 = t.get_weights()
+        t.train()
+        assert not _tree_equal(t.get_weights(), w0)
+        t.restore(ckpt)
+        assert _tree_equal(t.get_weights(), w0)
+    finally:
+        t.stop()
+
+
+def test_sac_trains(cluster):
+    from ray_tpu.rl import SACConfig, SACTrainer
+
+    cfg = SACConfig(num_rollout_workers=1, rollout_fragment_length=120,
+                    learning_starts=100, updates_per_iter=4)
+    t = SACTrainer(cfg)
+    try:
+        r1 = t.train()
+        r2 = t.train()
+        assert r2["timesteps_total"] == 240
+        assert np.isfinite(r2["critic_loss"])
+        assert np.isfinite(r2["actor_loss"])
+        assert r2["alpha"] > 0
+    finally:
+        t.stop()
+
+
+def test_impala_trains(cluster):
+    from ray_tpu.rl import ImpalaConfig, ImpalaTrainer
+
+    cfg = ImpalaConfig(num_rollout_workers=2, rollout_fragment_length=80,
+                       batches_per_iter=3)
+    t = ImpalaTrainer(cfg)
+    try:
+        r = t.train()
+        assert r["batches_consumed"] == 3
+        assert r["timesteps_total"] == 240
+        assert np.isfinite(r["total_loss"])
+        r = t.train()
+        assert r["timesteps_total"] == 480
+    finally:
+        t.stop()

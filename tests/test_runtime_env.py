@@ -1,0 +1,84 @@
+"""Runtime environments: env_vars, working_dir/py_modules packaging.
+
+Reference: python/ray/runtime_env/ + _private/runtime_env/packaging.py.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import runtime_env as renv
+
+
+def test_validate():
+    assert renv.validate(None) == {}
+    assert renv.validate({"env_vars": {"A": "1"}}) == {"env_vars": {"A": "1"}}
+    with pytest.raises(ValueError, match="not supported"):
+        renv.validate({"conda": {"dependencies": ["x"]}})
+    with pytest.raises(ValueError, match="unknown"):
+        renv.validate({"wat": 1})
+    with pytest.raises(TypeError):
+        renv.validate({"env_vars": {"A": 1}})
+
+
+def test_uri_is_content_addressed(tmp_path):
+    d = tmp_path / "pkg"
+    d.mkdir()
+    (d / "mod.py").write_text("X = 1\n")
+    u1 = renv.uri_for_directory(str(d))
+    u2 = renv.uri_for_directory(str(d))
+    assert u1 == u2 and u1.startswith("gcs://pkg_")
+    (d / "mod.py").write_text("X = 2\n")
+    assert renv.uri_for_directory(str(d)) != u1
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_env_vars_applied_and_restored(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RENV_PROBE": "42"}})
+    def read_env():
+        return os.environ.get("RENV_PROBE")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RENV_PROBE")
+
+    assert ray_tpu.get(read_env.remote()) == "42"
+    # a later task on the same worker must not see the leaked var
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_working_dir_ships_code(cluster, tmp_path):
+    d = tmp_path / "wd"
+    d.mkdir()
+    (d / "shipped_mod.py").write_text("def f():\n    return 'from-pkg'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(d)})
+    def use_mod():
+        import shipped_mod
+
+        return shipped_mod.f()
+
+    assert ray_tpu.get(use_mod.remote()) == "from-pkg"
+
+
+def test_py_modules_on_actor(cluster, tmp_path):
+    d = tmp_path / "mods"
+    d.mkdir()
+    (d / "actor_dep.py").write_text("VALUE = 7\n")
+
+    @ray_tpu.remote
+    class A:
+        def get(self):
+            import actor_dep
+
+            return actor_dep.VALUE
+
+    a = A.options(runtime_env={"py_modules": [str(d)]}).remote()
+    assert ray_tpu.get(a.get.remote()) == 7
