@@ -12,9 +12,11 @@ twist is at the edge: `DataIterator.iter_device_batches` double-buffers
 jax.device_put so the input pipeline overlaps the SPMD step (SURVEY.md §7.7).
 """
 
-from ray_tpu.data.dataset import (Dataset, DataIterator, from_items,
-                                  from_numpy, from_pandas, range as range_,
-                                  read_csv, read_json, read_parquet)
+from ray_tpu.data.dataset import (Dataset, DataIterator, from_arrow,
+                                  from_items, from_numpy, from_pandas,
+                                  range as range_, read_binary_files,
+                                  read_csv, read_images, read_json,
+                                  read_parquet, read_text, read_tfrecords)
 from ray_tpu.data import aggregate, preprocessors
 from ray_tpu.data.grouped import GroupedData
 
@@ -22,7 +24,8 @@ from ray_tpu.data.grouped import GroupedData
 range = range_
 
 __all__ = [
-    "Dataset", "DataIterator", "from_items", "from_numpy", "from_pandas",
-    "range", "read_csv", "read_json", "read_parquet", "aggregate",
+    "Dataset", "DataIterator", "from_arrow", "from_items", "from_numpy",
+    "from_pandas", "range", "read_binary_files", "read_csv", "read_images",
+    "read_json", "read_parquet", "read_text", "read_tfrecords", "aggregate",
     "preprocessors", "GroupedData",
 ]

@@ -386,6 +386,116 @@ class Dataset:
             pos = hi
         return Dataset(out_refs, [])
 
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join (ref: Dataset.join / join exchange op):
+        hash-partition both sides on the key in map tasks, then one join
+        task per partition pairs matching rows. Supports inner/left/right/
+        outer; non-key columns colliding on name get a ``_1`` suffix on the
+        right side, as the reference does."""
+        import ray_tpu
+
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        P = num_partitions or max(len(self._block_refs),
+                                  len(other._block_refs), 1)
+
+        def _hash_partition(ops, key):
+            @ray_tpu.remote
+            def _part(block):
+                block = _transform_block(block, ops)
+                if not isinstance(block, dict):
+                    block = _rows_to_block(block)
+                if not isinstance(block, dict) or key not in block:
+                    return tuple({} for _ in builtins.range(P)) \
+                        if P > 1 else {}
+                import zlib
+
+                def _khash(v):
+                    # crc32: stable across worker processes, unlike the
+                    # salted builtin str hash. Integral floats normalize to
+                    # int so 2 and 2.0 land in the same partition (they
+                    # compare equal in the join task).
+                    v = v.item() if hasattr(v, "item") else v
+                    if isinstance(v, float) and v.is_integer():
+                        v = int(v)
+                    return zlib.crc32(str(v).encode()) % P
+
+                col = np.asarray(block[key])
+                pid = np.asarray([_khash(v) for v in col])
+                out = []
+                for p in builtins.range(P):
+                    idx = np.flatnonzero(pid == p)
+                    out.append({c: np.asarray(v)[idx]
+                                for c, v in block.items()})
+                return tuple(out) if P > 1 else out[0]
+
+            return _part
+
+        pa = _hash_partition(self._ops, on)
+        pb = _hash_partition(other._ops, on)
+        a_parts = [pa.options(num_returns=P).remote(r) if P > 1
+                   else [pa.remote(r)] for r in self._block_refs]
+        b_parts = [pb.options(num_returns=P).remote(r) if P > 1
+                   else [pb.remote(r)] for r in other._block_refs]
+
+        @ray_tpu.remote
+        def _join_part(na, nb, *subs):
+            left = _block_concat([s for s in subs[:na] if _block_rows(s)])
+            right = _block_concat([s for s in subs[na:] if _block_rows(s)])
+            lrows = _rows_of(left) if isinstance(left, dict) else []
+            rrows = _rows_of(right) if isinstance(right, dict) else []
+            rindex: Dict[Any, List[dict]] = {}
+            for r in rrows:
+                rindex.setdefault(np.asarray(r[on]).item(), []).append(r)
+            rcols = list(right.keys()) if isinstance(right, dict) else []
+            lcols = list(left.keys()) if isinstance(left, dict) else []
+            matched_r = set()
+            out_rows: List[dict] = []
+            for lr in lrows:
+                k = np.asarray(lr[on]).item()
+                matches = rindex.get(k, [])
+                if matches:
+                    matched_r.add(k)
+                    for rr in matches:
+                        row = dict(lr)
+                        for c in rcols:
+                            if c == on:
+                                continue
+                            row[c if c not in row else f"{c}_1"] = rr[c]
+                        out_rows.append(row)
+                elif how in ("left", "outer"):
+                    row = dict(lr)
+                    for c in rcols:
+                        if c == on:
+                            continue
+                        row.setdefault(c if c not in lr else f"{c}_1",
+                                       np.nan)
+                    out_rows.append(row)
+            if how in ("right", "outer"):
+                for rr in rrows:
+                    if np.asarray(rr[on]).item() in matched_r:
+                        continue
+                    # key always survives, even when this partition saw no
+                    # left rows (lcols empty)
+                    row = {on: rr[on]}
+                    for c in lcols:
+                        if c != on:
+                            row[c] = np.nan
+                    for c in rcols:
+                        if c == on:
+                            continue
+                        row[c if c not in row else f"{c}_1"] = rr[c]
+                    out_rows.append(row)
+            return _rows_to_block(out_rows) if out_rows else {}
+
+        out_refs = []
+        for p in builtins.range(P):
+            subs = [ap[p] for ap in a_parts] + [bp[p] for bp in b_parts]
+            out_refs.append(_join_part.remote(len(a_parts), len(b_parts),
+                                              *subs))
+        return Dataset(out_refs, [])
+
     def limit(self, n: int) -> "Dataset":
         rows = self.take(n)
         from ray_tpu.data.dataset import _put_blocks
@@ -469,6 +579,102 @@ class Dataset:
             b = other.materialize()
             return Dataset(a._block_refs + b._block_refs, [])
         return Dataset(self._block_refs + other._block_refs, [])
+
+    # ---- output ------------------------------------------------------------
+
+    def _write_files(self, path: str, ext: str, write_one) -> List[str]:
+        """One write task per block → part-NNNNN.<ext> under `path`
+        (ref: Dataset.write_parquet et al., file-per-block layout)."""
+        import os
+
+        import ray_tpu
+
+        os.makedirs(path, exist_ok=True)
+        ops = self._ops
+
+        @ray_tpu.remote
+        def _w(block, out_path):
+            block = _transform_block(block, ops)
+            if not isinstance(block, dict):
+                block = _rows_to_block(block)
+            if not isinstance(block, dict):
+                block = {"value": np.asarray(block)}
+            write_one(block, out_path)
+            return out_path
+
+        refs = [_w.remote(ref, os.path.join(path, f"part-{i:05d}.{ext}"))
+                for i, ref in enumerate(self._block_refs)]
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str) -> List[str]:
+        def _one(block, out):
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            pq.write_table(pa.table(block), out)
+
+        return self._write_files(path, "parquet", _one)
+
+    def write_csv(self, path: str) -> List[str]:
+        def _one(block, out):
+            import pyarrow as pa
+            import pyarrow.csv as pc
+
+            pc.write_csv(pa.table(block), out)
+
+        return self._write_files(path, "csv", _one)
+
+    def write_json(self, path: str) -> List[str]:
+        def _one(block, out):
+            import json as _json
+
+            rows = _rows_of(block)
+            with open(out, "w") as f:
+                for r in rows:
+                    f.write(_json.dumps(
+                        {k: (v.item() if isinstance(v, np.generic)
+                             else v.tolist() if isinstance(v, np.ndarray)
+                             else v) for k, v in r.items()}) + "\n")
+
+        return self._write_files(path, "json", _one)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        blocks = [b for b in self._iter_blocks() if _block_rows(b)]
+        if not blocks:
+            return pd.DataFrame()
+        whole = _block_concat(blocks)
+        if not isinstance(whole, dict):
+            whole = _rows_to_block(whole)
+            if not isinstance(whole, dict):
+                whole = {"value": np.asarray(whole)}
+        return pd.DataFrame(
+            {k: list(v) if getattr(v, "ndim", 1) > 1 else v
+             for k, v in whole.items()})
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        whole = _block_concat(list(self._iter_blocks()))
+        if not isinstance(whole, dict):
+            whole = _rows_to_block(whole)
+        return pa.table(whole)
+
+    def stats(self) -> str:
+        """Execution summary (ref: Dataset.stats())."""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _n(b):
+            return _block_rows(b)
+
+        rows = ray_tpu.get([_n.remote(r) for r in self._block_refs])
+        total = sum(rows)
+        return (f"Dataset: {len(self._block_refs)} blocks, {total} rows "
+                f"(min {min(rows) if rows else 0} / "
+                f"max {max(rows) if rows else 0} rows/block), "
+                f"pending ops: {[o[0] for o in self._ops]}")
 
     def num_blocks(self) -> int:
         return len(self._block_refs)
@@ -643,3 +849,155 @@ def read_json(paths) -> Dataset:
                 for c in t.column_names}
 
     return _read_files(paths, reader)
+
+
+def read_text(paths) -> Dataset:
+    """One row per line: {"text": str} (ref: read_api.read_text)."""
+    def reader(path):
+        with open(path, "r", errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return {"text": np.asarray(lines, dtype=object)}
+
+    return _read_files(paths, reader)
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """One row per file: {"bytes": ...} (ref: read_api.read_binary_files)."""
+    def reader(path):
+        with open(path, "rb") as f:
+            data = f.read()
+        row = {"bytes": np.asarray([data], dtype=object)}
+        if include_paths:
+            row["path"] = np.asarray([path], dtype=object)
+        return row
+
+    return _read_files(paths, reader)
+
+
+def read_images(paths, *, size=None, mode: Optional[str] = None) -> Dataset:
+    """Decode images with PIL into {"image": HxWxC uint8}
+    (ref: datasource/image_datasource.py)."""
+    def reader(path):
+        from PIL import Image
+
+        im = Image.open(path)
+        if mode:
+            im = im.convert(mode)
+        if size:
+            im = im.resize(tuple(size))
+        arr = np.asarray(im)
+        return {"image": arr[None, ...]}
+
+    return _read_files(paths, reader)
+
+
+def _parse_tfrecord_example(buf: bytes) -> Dict[str, Any]:
+    """Minimal protobuf wire parse of tf.train.Example — enough to round-trip
+    Int64List/FloatList/BytesList features without a TF dependency
+    (ref: datasource/tfrecords_datasource.py, which uses tf.train.Example)."""
+    import struct
+
+    def varint(b, i):
+        x = s = 0
+        while True:
+            c = b[i]
+            x |= (c & 0x7F) << s
+            i += 1
+            if not c & 0x80:
+                return x, i
+            s += 7
+
+    def fields(b):
+        i = 0
+        while i < len(b):
+            tag, i = varint(b, i)
+            fnum, wt = tag >> 3, tag & 7
+            if wt == 0:
+                v, i = varint(b, i)
+            elif wt == 2:
+                ln, i = varint(b, i)
+                v = b[i:i + ln]
+                i += ln
+            elif wt == 5:
+                v = b[i:i + 4]
+                i += 4
+            elif wt == 1:
+                v = b[i:i + 8]
+                i += 8
+            else:
+                raise ValueError(f"wire type {wt}")
+            yield fnum, wt, v
+
+    out: Dict[str, Any] = {}
+    for fnum, _, features in fields(buf):     # Example.features = 1
+        if fnum != 1:
+            continue
+        for fn2, _, entry in fields(features):  # Features.feature = 1 (map)
+            if fn2 != 1:
+                continue
+            key, feat = None, b""
+            for fn3, _, v in fields(entry):
+                if fn3 == 1:
+                    key = v.decode()
+                elif fn3 == 2:
+                    feat = v
+            if key is None:
+                continue
+            for fn4, wt4, flist in fields(feat):  # Feature oneof
+                vals: List[Any] = []
+                for fn5, wt5, v in fields(flist):  # *List.value = 1
+                    if fn5 != 1:
+                        continue
+                    if fn4 == 1:                 # BytesList
+                        vals.append(v)
+                    elif fn4 == 2:               # FloatList
+                        if wt5 == 2:             # packed
+                            vals.extend(struct.unpack(
+                                f"<{len(v) // 4}f", v))
+                        else:
+                            vals.append(struct.unpack("<f", v)[0])
+                    elif fn4 == 3:               # Int64List
+                        def _signed(x):
+                            # proto int64 negatives arrive as 10-byte
+                            # varints; fold back to two's complement
+                            return x - (1 << 64) if x >= 1 << 63 else x
+
+                        if wt5 == 2:             # packed varints
+                            j = 0
+                            while j < len(v):
+                                x, j = varint(v, j)
+                                vals.append(_signed(x))
+                        else:
+                            vals.append(_signed(v))
+                out[key] = vals[0] if len(vals) == 1 else vals
+    return out
+
+
+def read_tfrecords(paths) -> Dataset:
+    """TFRecord container framing is public and simple: per record
+    {u64 length, u32 masked-crc(length), bytes, u32 masked-crc(data)};
+    payloads are tf.train.Example protos parsed by the wire-level reader
+    above. CRCs are not verified (matches the reference's default)."""
+    def reader(path):
+        import struct
+
+        rows: List[dict] = []
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    break
+                (length,) = struct.unpack("<Q", hdr)
+                f.read(4)
+                data = f.read(length)
+                f.read(4)
+                rows.append(_parse_tfrecord_example(data))
+        return _rows_to_block(rows)
+
+    return _read_files(paths, reader)
+
+
+def from_arrow(table, *, num_blocks: int = 8) -> Dataset:
+    return from_numpy(
+        {c: table[c].to_numpy(zero_copy_only=False)
+         for c in table.column_names}, num_blocks=num_blocks)

@@ -243,3 +243,181 @@ def test_sort_all_empty(ray_start_regular):
 
     ds = data.range(10, num_blocks=2).filter(lambda r: False)
     assert ds.sort("id").take_all() == []
+
+
+def test_join_inner_left_outer(ray_start_regular):
+    left = rd.from_numpy({"k": np.array([1, 2, 3, 4]),
+                          "a": np.array([10, 20, 30, 40])}, num_blocks=2)
+    right = rd.from_numpy({"k": np.array([2, 3, 5]),
+                           "b": np.array([200, 300, 500])}, num_blocks=2)
+
+    inner = left.join(right, on="k").take_all()
+    assert sorted((int(r["k"]), int(r["a"]), int(r["b"])) for r in inner) \
+        == [(2, 20, 200), (3, 30, 300)]
+
+    lrows = left.join(right, on="k", how="left").take_all()
+    assert sorted(int(r["k"]) for r in lrows) == [1, 2, 3, 4]
+    unmatched = [r for r in lrows if int(r["k"]) == 1]
+    assert np.isnan(unmatched[0]["b"])
+
+    orows = left.join(right, on="k", how="outer").take_all()
+    assert sorted(int(r["k"]) for r in orows) == [1, 2, 3, 4, 5]
+
+
+def test_join_name_collision(ray_start_regular):
+    left = rd.from_numpy({"k": np.array([1]), "v": np.array([7])})
+    right = rd.from_numpy({"k": np.array([1]), "v": np.array([9])})
+    rows = left.join(right, on="k").take_all()
+    assert len(rows) == 1
+    assert int(rows[0]["v"]) == 7 and int(rows[0]["v_1"]) == 9
+
+
+def test_write_read_roundtrip(ray_start_regular, tmp_path):
+    ds = rd.from_numpy({"x": np.arange(20), "y": np.arange(20) * 2.0},
+                       num_blocks=3)
+    pq_dir = str(tmp_path / "pq")
+    files = ds.write_parquet(pq_dir)
+    assert len(files) == 3
+    back = rd.read_parquet(pq_dir)
+    assert back.count() == 20
+    assert back.sum("x") == sum(range(20))
+
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    assert rd.read_csv(csv_dir).count() == 20
+
+    js_dir = str(tmp_path / "js")
+    ds.write_json(js_dir)
+    assert rd.read_json(js_dir).count() == 20
+
+
+def test_to_pandas_from_arrow(ray_start_regular):
+    import pyarrow as pa
+
+    ds = rd.from_numpy({"x": np.arange(5)})
+    df = ds.to_pandas()
+    assert list(df["x"]) == [0, 1, 2, 3, 4]
+    t = pa.table({"z": [1, 2, 3]})
+    assert rd.from_arrow(t).count() == 3
+    assert rd.from_numpy({"x": np.arange(5)}).to_arrow().num_rows == 5
+
+
+def test_read_text_binary(ray_start_regular, tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("hello\nworld\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+    bp = tmp_path / "b.bin"
+    bp.write_bytes(b"\x00\x01\x02")
+    rows = rd.read_binary_files(str(bp), include_paths=True).take_all()
+    assert rows[0]["bytes"] == b"\x00\x01\x02"
+    assert rows[0]["path"].endswith("b.bin")
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    from PIL import Image
+
+    arr = np.zeros((4, 6, 3), np.uint8)
+    arr[..., 0] = 255
+    Image.fromarray(arr).save(tmp_path / "im.png")
+    rows = rd.read_images(str(tmp_path / "im.png")).take_all()
+    assert rows[0]["image"].shape == (4, 6, 3)
+    assert rows[0]["image"][0, 0, 0] == 255
+
+
+def test_read_tfrecords(ray_start_regular, tmp_path):
+    import struct
+
+    def varint(x):
+        out = b""
+        while True:
+            b7 = x & 0x7F
+            x >>= 7
+            out += bytes([b7 | (0x80 if x else 0)])
+            if not x:
+                return out
+
+    def field(num, wt, payload):
+        return varint((num << 3) | wt) + payload
+
+    def ld(num, data):
+        return field(num, 2, varint(len(data)) + data)
+
+    def example(feats):
+        entries = b""
+        for k, (kind, vals) in feats.items():
+            if kind == "int64":
+                packed = b"".join(varint(v) for v in vals)
+                flist = ld(3, ld(1, packed) if len(vals) > 1
+                           else field(1, 0, varint(vals[0])))
+            elif kind == "float":
+                flist = ld(2, ld(1, struct.pack(f"<{len(vals)}f", *vals)))
+            else:
+                flist = ld(1, b"".join(ld(1, v) for v in vals))
+            entry = ld(1, k.encode()) + ld(2, flist)
+            entries += ld(1, entry)
+        return ld(1, entries)
+
+    path = tmp_path / "t.tfrecords"
+    with open(path, "wb") as f:
+        for i in range(3):
+            rec = example({"id": ("int64", [i]),
+                           "score": ("float", [i * 0.5, 1.0]),
+                           "name": ("bytes", [f"r{i}".encode()])})
+            f.write(struct.pack("<Q", len(rec)) + b"\x00" * 4 + rec
+                    + b"\x00" * 4)
+    rows = rd.read_tfrecords(str(path)).take_all()
+    assert len(rows) == 3
+    assert sorted(int(r["id"]) for r in rows) == [0, 1, 2]
+    r0 = [r for r in rows if int(r["id"]) == 0][0]
+    assert r0["name"] == b"r0"
+    assert abs(r0["score"][1] - 1.0) < 1e-6
+
+
+def test_dataset_stats(ray_start_regular):
+    s = rd.range(100, num_blocks=4).stats()
+    assert "4 blocks" in s and "100 rows" in s
+
+
+def test_join_outer_empty_left_partition(ray_start_regular):
+    left = rd.from_numpy({"k": np.array([2]), "a": np.array([20])})
+    right = rd.from_numpy({"k": np.array([5]), "b": np.array([500])})
+    rows = left.join(right, on="k", how="outer", num_partitions=2).take_all()
+    assert sorted(int(r["k"]) for r in rows) == [2, 5]
+
+
+def test_join_mixed_numeric_dtypes(ray_start_regular):
+    left = rd.from_numpy({"k": np.array([2]), "a": np.array([1])})
+    right = rd.from_numpy({"k": np.array([2.0]), "b": np.array([9])})
+    rows = left.join(right, on="k", num_partitions=4).take_all()
+    assert len(rows) == 1 and int(rows[0]["b"]) == 9
+
+
+def test_tfrecords_negative_int64(ray_start_regular, tmp_path):
+    import struct
+
+    def varint(x):
+        out = b""
+        while True:
+            b7 = x & 0x7F
+            x >>= 7
+            out += bytes([b7 | (0x80 if x else 0)])
+            if not x:
+                return out
+
+    def field(num, wt, payload):
+        return varint((num << 3) | wt) + payload
+
+    def ld(num, data):
+        return field(num, 2, varint(len(data)) + data)
+
+    neg = varint((-3) & ((1 << 64) - 1))      # proto int64 -3 as 10B varint
+    flist = ld(3, field(1, 0, neg))
+    entry = ld(1, b"label") + ld(2, flist)
+    rec = ld(1, ld(1, entry))
+    path = tmp_path / "n.tfrecords"
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(rec)) + b"\0" * 4 + rec + b"\0" * 4)
+    rows = rd.read_tfrecords(str(path)).take_all()
+    assert int(rows[0]["label"]) == -3
