@@ -37,6 +37,11 @@ class Config:
     worker_idle_timeout_s: float = 300.0
     scheduler_spread_threshold: float = 0.5      # ref: RAY_scheduler_spread_threshold
     scheduler_top_k_fraction: float = 0.2        # ref: hybrid_scheduling_policy.h:29
+    # --- OOM defense (ref: memory_monitor.h:52, ray_config_def.h:74) --------
+    memory_monitor_refresh_ms: int = 0           # 0 disables (ref default 250)
+    memory_usage_threshold: float = 0.95
+    memory_monitor_kill_policy: str = "group_by_owner"  # | "retriable_fifo"
+    memory_monitor_test_usage_file: str = ""     # tests: file with fake fraction
     # --- health / failure detection -----------------------------------------
     health_check_period_s: float = 1.0           # ref: ray_config_def.h:793-799
     health_check_timeout_s: float = 5.0

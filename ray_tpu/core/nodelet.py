@@ -36,6 +36,8 @@ from ray_tpu.core.common import Address, NodeInfo, ResourceSet, TaskSpec
 from ray_tpu.core.config import Config
 from ray_tpu.core.external_storage import FilesystemStorage
 from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID
+from ray_tpu.core.memory_monitor import (KillCandidate, MemoryMonitor,
+                                         pick_worker_to_kill)
 from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.core.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
 
@@ -51,14 +53,19 @@ class WorkerRecord:
         self.lease_id: Optional[bytes] = None
         self.job_id: Optional[bytes] = None
         self.last_idle = time.time()
+        self.lease_time = 0.0          # when the current lease was granted
+        self.retriable = True          # current task retries on worker death
         self.ready = asyncio.Event()
 
 
 class _PendingLease:
-    def __init__(self, resources: ResourceSet, pg, fut):
+    def __init__(self, resources: ResourceSet, pg, fut, job_id=None,
+                 retriable=True):
         self.resources = resources
         self.pg = pg                   # (pg_id, bundle_index) or None
         self.fut: asyncio.Future = fut
+        self.job_id = job_id
+        self.retriable = retriable
 
 
 class Nodelet:
@@ -95,6 +102,8 @@ class Nodelet:
         self._restored = 0
         self._hb_seq = 0
         self._stopping = False
+        self.memory_monitor = MemoryMonitor(
+            cfg.memory_usage_threshold, cfg.memory_monitor_test_usage_file)
 
     # ------------------------------------------------------------------- boot
 
@@ -121,6 +130,8 @@ class Nodelet:
         loop.create_task(self._log_loop())
         if self.spill is not None:
             loop.create_task(self._spill_loop())
+        if self.cfg.memory_monitor_refresh_ms > 0:
+            loop.create_task(self._memory_monitor_loop())
         for _ in range(self.cfg.worker_pool_prestart):
             loop.create_task(self._start_worker())
         return addr
@@ -141,7 +152,6 @@ class Nodelet:
     async def _reap_loop(self):
         """Detect worker deaths; free leases; report to GCS
         (ref: NodeManager worker failure path / HandleUnexpectedWorkerFailure)."""
-        gcs = self.pool.get(self.gcs_addr)
         while not self._stopping:
             await asyncio.sleep(0.1)
             now = time.time()
@@ -153,12 +163,7 @@ class Nodelet:
                     was = w.state
                     self._on_worker_dead(w)
                     if was in ("leased", "actor"):
-                        try:
-                            await gcs.call("report_worker_death", worker_id=w.worker_id,
-                                           node_id=self.node_id,
-                                           reason=f"exit code {rc}")
-                        except Exception:
-                            pass
+                        await self._report_worker_death(w, f"exit code {rc}")
                 elif (w.state == "idle"
                       and now - w.last_idle > self.cfg.worker_idle_timeout_s
                       and len(self.workers) > self.cfg.worker_pool_prestart):
@@ -238,11 +243,59 @@ class Nodelet:
 
     def _kill_worker(self, w: WorkerRecord, reason: str):
         logger.info("killing worker %s: %s", w.worker_id.hex()[:8], reason)
+        was = w.state
         try:
             w.proc.terminate()
         except Exception:
             pass
         self._on_worker_dead(w)
+        if was in ("leased", "actor"):
+            # Deliberate kills of busy workers (OOM, shutdown, requested)
+            # must reach the control plane so actor FSMs restart / owners
+            # learn the death reason (ref: NodeManager worker failure path).
+            try:
+                asyncio.get_running_loop().create_task(
+                    self._report_worker_death(w, reason))
+            except RuntimeError:
+                pass
+
+    async def _report_worker_death(self, w: WorkerRecord, reason: str):
+        try:
+            await self.pool.get(self.gcs_addr).call(
+                "report_worker_death", worker_id=w.worker_id,
+                node_id=self.node_id, reason=reason, timeout=5.0)
+        except Exception:
+            pass
+
+    async def _memory_monitor_loop(self):
+        """Kill a worker when host memory crosses the threshold
+        (ref: memory_monitor.h:52 polling + worker_killing_policy*.h)."""
+        mm = self.memory_monitor
+        period = self.cfg.memory_monitor_refresh_ms / 1000.0
+        while not self._stopping:
+            await asyncio.sleep(period)
+            try:
+                if not mm.above_threshold():
+                    continue
+                cands = [KillCandidate(w.worker_id, w.job_id,
+                                       w.state == "actor",
+                                       w.retriable and w.state == "leased",
+                                       w.lease_time)
+                         for w in self.workers.values()
+                         if w.state in ("leased", "actor")]
+                victim = pick_worker_to_kill(
+                    cands, self.cfg.memory_monitor_kill_policy)
+                if victim is None:
+                    continue
+                w = self.workers.get(victim.worker_id)
+                if w is not None:
+                    mm.kills += 1
+                    self._kill_worker(
+                        w, f"OOM: node memory usage "
+                        f"{mm.usage_fraction():.2f} > {mm.threshold:.2f} "
+                        "(memory monitor)")
+            except Exception:
+                logger.exception("memory monitor pass failed")
 
     async def rpc_register_worker(self, worker_id: bytes, addr: Address) -> dict:
         w = self.workers.get(worker_id)
@@ -295,7 +348,9 @@ class Nodelet:
 
     async def rpc_request_lease(self, resources: ResourceSet,
                                 pg: Optional[Tuple] = None,
-                                grant_or_reject: bool = False) -> dict:
+                                grant_or_reject: bool = False,
+                                job_id: Optional[bytes] = None,
+                                retriable: bool = True) -> dict:
         pool = self._resource_pool(pg)
         if pool is None:
             return {"status": "infeasible", "error": "placement group bundle not here"}
@@ -309,7 +364,7 @@ class Nodelet:
             return {"status": "infeasible",
                     "error": f"no node can satisfy {resources.quantities}"}
         if resources.fits_in(pool):
-            return await self._grant(resources, pg)
+            return await self._grant(resources, pg, job_id, retriable)
         if grant_or_reject:
             return {"status": "rejected"}
         # Feasible but busy → try spillback to an idle peer, else queue here
@@ -320,7 +375,7 @@ class Nodelet:
                 return {"status": "spillback", "addr": target["addr"],
                         "node_id": target["node_id"]}
         fut = asyncio.get_running_loop().create_future()
-        self.pending.append(_PendingLease(resources, pg, fut))
+        self.pending.append(_PendingLease(resources, pg, fut, job_id, retriable))
         try:
             return await asyncio.wait_for(fut, self.cfg.worker_lease_timeout_s)
         except asyncio.TimeoutError:
@@ -334,7 +389,9 @@ class Nodelet:
         except (ConnectionLost, RemoteError, OSError):
             return None
 
-    async def _grant(self, resources: ResourceSet, pg: Optional[Tuple]) -> dict:
+    async def _grant(self, resources: ResourceSet, pg: Optional[Tuple],
+                     job_id: Optional[bytes] = None,
+                     retriable: bool = True) -> dict:
         pool = self._resource_pool(pg)
         pool.subtract(resources)
         w = await self._pop_worker()
@@ -344,6 +401,9 @@ class Nodelet:
         lease_id = os.urandom(16)
         w.state = "leased"
         w.lease_id = lease_id
+        w.job_id = job_id
+        w.lease_time = time.time()
+        w.retriable = retriable
         self.leases[lease_id] = w
         self.lease_resources[lease_id] = (resources, pg)
         return {"status": "granted", "lease_id": lease_id,
@@ -379,7 +439,8 @@ class Nodelet:
                 continue
             if pool is not None and p.resources.fits_in(pool):
                 async def _do(p=p):
-                    r = await self._grant(p.resources, p.pg)
+                    r = await self._grant(p.resources, p.pg, p.job_id,
+                                          p.retriable)
                     if not p.fut.done():
                         p.fut.set_result(r)
                 loop.create_task(_do())
@@ -395,7 +456,9 @@ class Nodelet:
         pg = None
         if spec.scheduling.kind == "PLACEMENT_GROUP":
             pg = (spec.scheduling.pg_id, spec.scheduling.bundle_index)
-        r = await self.rpc_request_lease(resources=spec.resources, pg=pg)
+        r = await self.rpc_request_lease(
+            resources=spec.resources, pg=pg, job_id=spec.job_id.binary(),
+            retriable=False)
         if r["status"] != "granted":
             return {"ok": False, "retryable": r["status"] in ("retry", "spillback"),
                     "error": r.get("error", r["status"])}
@@ -644,6 +707,7 @@ class Nodelet:
                               if self.spill is not None else 0),
             "restored_objects": self._restored,
             "pending_leases": len(self.pending),
+            "oom_kills": self.memory_monitor.kills,
         }
 
     async def rpc_ping(self) -> dict:

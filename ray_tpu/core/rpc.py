@@ -243,9 +243,33 @@ class EventLoopThread:
         self.loop.run_forever()
 
     def run(self, coro, timeout: Optional[float] = None):
-        """Run coroutine on the loop from another thread; blocks for result."""
+        """Run coroutine on the loop from another thread; blocks for result.
+
+        Never blocks past loop death: if the loop stops (shutdown) while a
+        caller waits, raise ConnectionLost instead of hanging — otherwise a
+        non-daemon executor thread parked in fut.result(None) deadlocks
+        interpreter exit (concurrent.futures joins its threads at exit)."""
+        import time as _time
+
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
-        return fut.result(timeout)
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            step = 0.5
+            if deadline is not None:
+                step = min(step, max(deadline - _time.monotonic(), 0.0))
+            try:
+                return fut.result(step)
+            except TimeoutError:
+                if fut.done():
+                    # Completed during the poll window: surface the real
+                    # outcome (result, or the coroutine's own exception).
+                    return fut.result()
+                if not self.loop.is_running() or not self._thread.is_alive():
+                    fut.cancel()
+                    raise ConnectionLost("runtime event loop stopped") from None
+                if deadline is not None and _time.monotonic() >= deadline:
+                    fut.cancel()
+                    raise
 
     def spawn(self, coro):
         """Fire-and-forget from any thread."""

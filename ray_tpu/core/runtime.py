@@ -681,7 +681,8 @@ class Runtime:
                     spec = q.popleft()
                 except IndexError:
                     break
-                await self._push_and_handle(spec, lw, cls)
+                if not await self._push_and_handle(spec, lw, cls):
+                    break     # worker died; retries repump on a fresh lease
         finally:
             self._class_leases[cls].remove(lw)
             await self._return_lease(lw)
@@ -705,6 +706,8 @@ class Runtime:
             try:
                 r = await self.pool.get(tuple(target)).call(
                     "request_lease", resources=spec.resources, pg=pg,
+                    job_id=spec.job_id.binary(),
+                    retriable=spec.max_retries != 0,
                     timeout=self.cfg.worker_lease_timeout_s + 10.0)
             except (ConnectionLost, RemoteError, OSError) as e:
                 logger.warning("lease request to %s failed: %s", target, e)
@@ -751,7 +754,11 @@ class Runtime:
         except Exception:
             pass
 
-    async def _push_and_handle(self, spec: TaskSpec, lw: _LeasedWorker, cls: Tuple):
+    async def _push_and_handle(self, spec: TaskSpec, lw: _LeasedWorker,
+                               cls: Tuple) -> bool:
+        """Push one task to a leased worker. Returns False when the worker
+        is dead (the caller must abandon this lease; retries are re-enqueued
+        and repumped onto a fresh lease)."""
         self._record_event(spec, "RUNNING")
         try:
             result: TaskResult = await self.pool.get(lw.worker_addr).call(
@@ -768,8 +775,9 @@ class Runtime:
             else:
                 self._fail_task_returns(spec, WorkerCrashedError(
                     f"worker died running {spec.name}: {e}"))
-            return
+            return False
         self._complete_task(spec, result, cls)
+        return True
 
     def _complete_task(self, spec: TaskSpec, result: TaskResult, cls: Optional[Tuple]):
         app_error = None

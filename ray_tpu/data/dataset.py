@@ -448,8 +448,20 @@ class Dataset:
             rindex: Dict[Any, List[dict]] = {}
             for r in rrows:
                 rindex.setdefault(np.asarray(r[on]).item(), []).append(r)
-            rcols = list(right.keys()) if isinstance(right, dict) else []
-            lcols = list(left.keys()) if isinstance(left, dict) else []
+            # Column sets come from every source block's partition output
+            # (not just this partition's non-empty rows), so fill columns
+            # are stable even when one side is empty in this partition.
+            def _cols(ds):
+                cols: List[str] = []
+                for d in ds:
+                    if isinstance(d, dict):
+                        for c in d.keys():
+                            if c not in cols:
+                                cols.append(c)
+                return cols
+
+            lcols = _cols(subs[:na])
+            rcols = _cols(subs[na:])
             matched_r = set()
             out_rows: List[dict] = []
             for lr in lrows:
@@ -671,7 +683,10 @@ class Dataset:
 
         rows = ray_tpu.get([_n.remote(r) for r in self._block_refs])
         total = sum(rows)
-        return (f"Dataset: {len(self._block_refs)} blocks, {total} rows "
+        # Counts describe the stored source blocks; pending lazy ops (which
+        # may change row counts, e.g. filter) run at materialization.
+        kind = "source rows" if self._ops else "rows"
+        return (f"Dataset: {len(self._block_refs)} blocks, {total} {kind} "
                 f"(min {min(rows) if rows else 0} / "
                 f"max {max(rows) if rows else 0} rows/block), "
                 f"pending ops: {[o[0] for o in self._ops]}")
@@ -990,6 +1005,10 @@ def read_tfrecords(paths) -> Dataset:
                 (length,) = struct.unpack("<Q", hdr)
                 f.read(4)
                 data = f.read(length)
+                if len(data) < length:
+                    raise ValueError(
+                        f"truncated TFRecord in {path}: record claims "
+                        f"{length} bytes, file ends after {len(data)}")
                 f.read(4)
                 rows.append(_parse_tfrecord_example(data))
         return _rows_to_block(rows)
