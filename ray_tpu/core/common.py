@@ -228,6 +228,7 @@ class TaskSpec:
 class TaskResult:
     """Reply of a task push (ref: PushTaskReply proto)."""
     task_id: TaskID
-    # per-return: ("inline", pickled) | ("store", ObjectID) | ("err", SerializedException)
+    # per-return: ("inline", pickled) | ("store", {"addr","size"}) |
+    #             ("err", SerializedException)
     returns: List[Tuple[str, Any]]
     worker_id: bytes = b""

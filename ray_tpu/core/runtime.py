@@ -69,7 +69,8 @@ def set_runtime(rt: Optional["Runtime"]):
 class _ObjectEntry:
     """Owner-side directory entry (ref: ObjectDirectory + memory store)."""
 
-    __slots__ = ("state", "inline", "locations", "error", "event", "spec")
+    __slots__ = ("state", "inline", "locations", "error", "event", "spec",
+                 "size")
 
     def __init__(self):
         self.state = "pending"        # pending | ready | error | lost
@@ -78,6 +79,7 @@ class _ObjectEntry:
         self.error = None             # SerializedException
         self.event = threading.Event()
         self.spec: Optional[TaskSpec] = None   # lineage for reconstruction
+        self.size = 0                 # stored bytes (locality scheduling)
 
 
 class _LeasedWorker:
@@ -289,6 +291,7 @@ class Runtime:
             if _pin:
                 self._pin_primary(oid)
             e.locations.add(self.nodelet_addr)
+            e.size = size
         e.state = "ready"
         e.event.set()
         return ObjectRef(oid, self.address)
@@ -645,11 +648,50 @@ class Runtime:
             spec_args.append(("kw", kw))
         return spec_args, arg_ids
 
+    def _owned_ref_args(self, spec: TaskSpec) -> List[ObjectID]:
+        out = []
+        for kind, payload in spec.args:
+            items = [payload] if kind == "ref" else (
+                [pv for (kk, pv) in payload.values() if kk == "ref"]
+                if kind == "kw" else [])
+            for oid, owner in items:
+                if owner.addr == self.address.addr:
+                    out.append(oid)
+        return out
+
     def _submit_spec(self, spec: TaskSpec, retries_left: int):
         self._inflight.setdefault(spec.task_id, _PendingTask(spec, retries_left))
-        cls = spec.scheduling_class()
+        pending = [oid for oid in self._owned_ref_args(spec)
+                   if not self._entry(oid).event.is_set()]
+        if pending:
+            # Resolve dependencies before leasing (ref: transport/
+            # dependency_resolver.h): the lease target then sees final
+            # locations, so locality-aware leasing can follow the data.
+            self._spawn(self._enqueue_when_ready(spec, pending))
+        else:
+            self._enqueue_now(spec)
+
+    def _enqueue_now(self, spec: TaskSpec):
+        # The queue key includes the locality target (deps are resolved by
+        # now, so it's final): a lease acquired for one queue only ever
+        # drains tasks that want that same placement, so pipelining can't
+        # drag a task onto a node its own data isn't on.
+        target = (self._locality_target(spec)
+                  if spec.scheduling.kind == "DEFAULT" else None)
+        cls = (spec.scheduling_class(), target)
         self._queues[cls].append(spec)
         self._spawn(self._pump_class(cls))
+
+    async def _enqueue_when_ready(self, spec: TaskSpec,
+                                  pending: List[ObjectID]):
+        for oid in pending:
+            e = self._entry(oid)
+            while not e.event.is_set() and not self._shutdown:
+                await asyncio.sleep(0.005)
+        # Errored/lost deps still dispatch: the executing worker surfaces
+        # the dependency failure as the task's error (same as the ref,
+        # where the raylet cancels on dep failure and the owner raises).
+        self._enqueue_now(spec)
 
     async def _pump_class(self, cls: Tuple):
         """One pump == one leased worker draining this class's queue. Each
@@ -662,7 +704,7 @@ class Runtime:
             return
         self._class_pending_lease[cls] += 1
         try:
-            lw = await self._acquire_lease(q[0])
+            lw = await self._acquire_lease(q[0], preferred=cls[1])
         except Exception:
             logger.exception("lease acquisition failed")
             lw = None
@@ -687,8 +729,29 @@ class Runtime:
             self._class_leases[cls].remove(lw)
             await self._return_lease(lw)
 
-    async def _acquire_lease(self, spec: TaskSpec) -> Optional[_LeasedWorker]:
-        target = self.nodelet_addr
+    def _locality_target(self, spec: TaskSpec) -> Optional[Address]:
+        """Lease-target choice by data locality (ref: lease_policy.h
+        LocalityAwareLeasePolicy): prefer the nodelet already holding the
+        most argument bytes, so big args need no transfer. Only owned,
+        store-resident args count — inlined values and borrowed refs
+        (whose locations live at their owner) don't steer placement."""
+        scores: Dict[Address, int] = {}
+        for oid in self._owned_ref_args(spec):
+            with self._dir_lock:
+                e = self.directory.get(oid)
+            if e is None or e.state != "ready" or e.inline is not None:
+                continue
+            for loc in e.locations:
+                loc = tuple(loc)
+                scores[loc] = scores.get(loc, 0) + max(e.size, 1)
+        if not scores:
+            return None
+        return max(scores.items(), key=lambda kv: kv[1])[0]
+
+    async def _acquire_lease(self, spec: TaskSpec,
+                             preferred: Optional[Address] = None
+                             ) -> Optional[_LeasedWorker]:
+        target = preferred or self.nodelet_addr
         pg = None
         if spec.scheduling.kind == "PLACEMENT_GROUP":
             pg = (spec.scheduling.pg_id, spec.scheduling.bundle_index)
@@ -739,7 +802,7 @@ class Runtime:
         err = RuntimeError(
             f"infeasible task: no node can satisfy "
             f"{spec.resources.quantities} within deadline")
-        q = self._queues[spec.scheduling_class()]
+        q = self._queues[(spec.scheduling_class(), preferred)]
         self._fail_task_returns(spec, err)
         while q:
             s = q.popleft()
@@ -802,7 +865,11 @@ class Runtime:
                 except Exception:
                     pass
             elif kind == "store":
-                e.locations.add(tuple(payload))
+                if isinstance(payload, dict):
+                    e.locations.add(tuple(payload["addr"]))
+                    e.size = payload.get("size", 0)
+                else:
+                    e.locations.add(tuple(payload))
             elif kind == "err":
                 e.error = payload
                 e.state = "error"

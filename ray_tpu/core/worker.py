@@ -104,7 +104,8 @@ class Worker:
                     self.runtime._pin_primary(rid)  # nodelet owns the pin
                 elif not store.contains(rid):
                     raise MemoryError(f"object store full storing return {i}")
-                returns.append(("store", self.runtime.nodelet_addr))
+                returns.append(("store", {"addr": self.runtime.nodelet_addr,
+                                          "size": size}))
         return TaskResult(spec.task_id, returns)
 
     def _execute(self, spec: TaskSpec, fn=None) -> TaskResult:

@@ -146,3 +146,53 @@ def test_lineage_reconstruction(ray_start_cluster):
     time.sleep(2.0)
     out = ray_tpu.get(ref, timeout=60)
     assert float(out.sum()) == 300_000.0
+
+
+def test_locality_aware_lease_target(ray_start_cluster):
+    """DEFAULT-strategy tasks lease from the node holding their big args
+    (ref: lease_policy.h LocalityAwareLeasePolicy)."""
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 2.0})                 # driver's node
+    remote_node = cluster.add_node(resources={"CPU": 2.0, "b": 1.0})
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"b": 0.001})
+    def produce():
+        return np.ones(300_000, dtype=np.float64)   # big -> store-resident
+
+    @ray_tpu.remote
+    def where(arr):
+        from ray_tpu.core.runtime import get_runtime
+
+        return (float(arr.sum()), tuple(get_runtime().nodelet_addr))
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=30)
+    total, addr = ray_tpu.get(where.remote(ref), timeout=60)
+    assert total == 300_000.0
+    assert addr == tuple(remote_node.addr)          # followed the data
+
+    # Small (inlined) args don't steer placement off the local node.
+    from ray_tpu.core.runtime import get_runtime as _grt
+
+    driver_nodelet = tuple(_grt().nodelet_addr)
+    small = ray_tpu.put(3)
+
+    @ray_tpu.remote
+    def where_small(x):
+        from ray_tpu.core.runtime import get_runtime
+
+        return tuple(get_runtime().nodelet_addr)
+
+    assert ray_tpu.get(where_small.remote(small),
+                       timeout=60) == driver_nodelet
+
+    # Mixed locality in the same scheduling class: each task follows its
+    # own data, so pipelined leases never drag a task off its data's node.
+    local_big = ray_tpu.put(np.ones(300_000))
+    ref2 = produce.remote()
+    a = where.remote(ref2)
+    b = where.remote(local_big)
+    (_, addr_a), (_, addr_b) = ray_tpu.get([a, b], timeout=60)
+    assert addr_a == tuple(remote_node.addr)
+    assert addr_b == driver_nodelet
