@@ -66,6 +66,23 @@ class Cluster:
         node.kill()
         self.nodes.remove(node)
 
+    def kill_gcs(self):
+        """Hard-kill the control plane (ref: GCS fault-tolerance tests,
+        test_gcs_fault_tolerance.py)."""
+        try:
+            self.gcs_proc.kill()
+            self.gcs_proc.wait(timeout=5)
+        except Exception:
+            pass
+
+    def restart_gcs(self):
+        """Restart GCS on the SAME address so nodelets/drivers reconnect.
+        Requires cfg.gcs_storage='file' for state to survive."""
+        self.kill_gcs()
+        self.gcs_proc, self.gcs_addr = start_gcs(
+            self.session_dir, self.cfg, host=self.gcs_addr[0],
+            port=self.gcs_addr[1])
+
     def connect(self, **kwargs):
         import ray_tpu
 

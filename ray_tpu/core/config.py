@@ -51,6 +51,9 @@ class Config:
     # --- gcs ----------------------------------------------------------------
     gcs_storage: str = "memory"                  # "memory" | "file" (ft restart)
     gcs_file_storage_path: str = ""
+    # How long clients retry GCS calls across a restart (ref:
+    # gcs_failover_worker_reconnect_timeout ray_config_def.h:62).
+    gcs_reconnect_timeout_s: float = 30.0
     # --- timeouts -----------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     get_timeout_warn_s: float = 10.0

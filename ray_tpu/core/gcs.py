@@ -94,6 +94,9 @@ class GcsServer:
         self.server = RpcServer(self)
         self._round_robin = 0
         self._stopping = False
+        self._dirty = False
+        # node_id -> {actor_id_hex: {"addr", "worker_id"}} from re-registration
+        self._hosted: Dict[NodeID, dict] = {}
 
     # ------------------------------------------------------------------ boot
 
@@ -101,8 +104,35 @@ class GcsServer:
         self.server.host, self.server.port = host, port
         addr = await self.server.start()
         self._maybe_restore()
-        asyncio.get_running_loop().create_task(self._health_loop())
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._health_loop())
+        if self._snapshot_path():
+            loop.create_task(self._snapshot_loop())
+        if self.actors:
+            # Restored from a snapshot: reconcile after nodelets rejoin
+            # (ref: gcs_actor_manager restart reconstruction on failover).
+            loop.create_task(self._failover_reconcile())
         return addr
+
+    async def _failover_reconcile(self):
+        """Post-restart actor reconciliation. Surviving nodelets re-register
+        within a heartbeat (their register_node carries hosted actors, which
+        rpc_register_node adopts). After that grace window:
+        - still-PENDING/RESTARTING records re-drive creation (their original
+          creation either never ran or was adopted above),
+        - ALIVE records whose node never came back, or whose worker is no
+          longer hosted there, get the normal restart FSM treatment."""
+        await asyncio.sleep(max(1.0, self.cfg.health_check_period_s * 3))
+        for rec in list(self.actors.values()):
+            if rec.state in (PENDING_CREATION, RESTARTING):
+                asyncio.get_running_loop().create_task(self._create_actor(rec))
+            elif rec.state == ALIVE:
+                info = self.nodes.get(rec.node_id)
+                hosted = self._hosted.get(rec.node_id, {})
+                if (info is None or not info.alive
+                        or rec.actor_id.hex() not in hosted):
+                    await self._reconstruct_actor(
+                        rec, "worker lost during GCS failover")
 
     async def _health_loop(self):
         period = self.cfg.health_check_period_s
@@ -135,14 +165,28 @@ class GcsServer:
                     b["node_id"] = None
                     changed = True
             if changed:
+                self._mark_dirty()
                 await self._try_place_pg(pgid)
 
     # -------------------------------------------------------------- membership
 
-    async def rpc_register_node(self, info: NodeInfo) -> dict:
+    async def rpc_register_node(self, info: NodeInfo,
+                                hosted: Optional[dict] = None) -> dict:
         self.nodes[info.node_id] = info
         self.available[info.node_id] = info.resources_total.copy()
         self.last_seen[info.node_id] = time.time()
+        # A rejoining nodelet reports the actors it hosts; adopt them so a
+        # restarted GCS doesn't double-create actors whose creation landed
+        # after the last snapshot (ref: failover reconstruction).
+        self._hosted[info.node_id] = hosted or {}
+        for aid_hex, h in (hosted or {}).items():
+            for rec in self.actors.values():
+                if rec.actor_id.hex() == aid_hex and rec.state != ALIVE:
+                    rec.state = ALIVE
+                    rec.address = tuple(h["addr"])
+                    rec.worker_id = h["worker_id"]
+                    rec.node_id = info.node_id
+                    await self._publish_actor(rec)
         await self._publish("node", {"node_id": info.node_id, "alive": True})
         return {"ok": True, "config": self.cfg.to_json()}
 
@@ -156,6 +200,11 @@ class GcsServer:
                 self.available[node_id] = available
                 self.pending_leases[node_id] = pending_leases
         self.last_seen[node_id] = time.time()
+        if node_id not in self.nodes:
+            # Fresh GCS after restart: membership is rebuilt from the
+            # still-running nodelets (ref: clients resubscribe/re-register
+            # after GCS failover, _raylet.pyx _auto_reconnect).
+            return {"ok": False, "reregister": True}
         info = self.nodes.get(node_id)
         if info is not None and not info.alive:
             # Node came back (e.g. transient stall) — reference treats this as
@@ -235,16 +284,27 @@ class GcsServer:
     # ------------------------------------------------------------------ actors
 
     async def rpc_register_actor(self, spec: TaskSpec) -> dict:
-        """ref: gcs_actor_manager.cc:246 RegisterActor."""
+        """ref: gcs_actor_manager.cc:246 RegisterActor. Idempotent: clients
+        retry across GCS restarts (gcs_call auto-reconnect), so a replayed
+        registration of an already-known actor_id must succeed without
+        double-creating."""
+        if spec.actor_id in self.actors:
+            return {"ok": True}
         if spec.actor_name:
             key = (spec.namespace, spec.actor_name)
-            if key in self.named_actors:
+            if key in self.named_actors and self.named_actors[key] != spec.actor_id:
                 existing = self.actors[self.named_actors[key]]
                 if existing.state != DEAD:
                     return {"ok": False, "error": f"actor name {key} taken"}
             self.named_actors[key] = spec.actor_id
         rec = ActorRecord(spec)
         self.actors[spec.actor_id] = rec
+        # Write-through: registration must survive an immediate GCS crash
+        # (ref: Redis-backed GcsTableStorage persists before the reply).
+        # The whole-state snapshot also captures the function-export KV
+        # writes that preceded this registration. Serialization happens on
+        # the loop (consistent view); the file write runs off-loop.
+        await self._snapshot_async()
         asyncio.get_running_loop().create_task(self._create_actor(rec))
         return {"ok": True}
 
@@ -384,7 +444,7 @@ class GcsServer:
 
     async def _publish_actor(self, rec: ActorRecord):
         await self._publish(f"actor:{rec.actor_id.hex()}", rec.view())
-        self._maybe_snapshot()
+        self._mark_dirty()
 
     # -------------------------------------------------------- placement groups
 
@@ -403,6 +463,7 @@ class GcsServer:
             "state": "PENDING",
         }
         ok = await self._try_place_pg(pg_id)
+        self._mark_dirty()
         return {"ok": ok, "state": self.pgs[pg_id]["state"]}
 
     async def _try_place_pg(self, pg_id: PlacementGroupID) -> bool:
@@ -411,6 +472,7 @@ class GcsServer:
         unplaced = [b for b in pg["bundles"] if b["node_id"] is None]
         if not unplaced:
             pg["state"] = "CREATED"
+            self._mark_dirty()
             return True
         # Phase 0: pick nodes for every unplaced bundle against a scratch view.
         scratch = {nid: rs.copy() for nid, rs in self.available.items()
@@ -480,6 +542,7 @@ class GcsServer:
         pg = self.pgs.pop(pg_id, None)
         if pg is None:
             return {"ok": False}
+        self._mark_dirty()
         for b in pg["bundles"]:
             nid = b.get("node_id")
             if nid is not None and nid in self.nodes:
@@ -520,11 +583,13 @@ class GcsServer:
     async def rpc_add_job(self, job_id: JobID, driver_addr: Address, meta: dict) -> dict:
         self.jobs[job_id] = {"job_id": job_id, "driver": driver_addr,
                              "meta": meta, "start": time.time(), "end": None}
+        self._mark_dirty()
         return {"ok": True}
 
     async def rpc_finish_job(self, job_id: JobID) -> dict:
         if job_id in self.jobs:
             self.jobs[job_id]["end"] = time.time()
+            self._mark_dirty()
         return {"ok": True}
 
     async def rpc_list_jobs(self) -> List[dict]:
@@ -534,15 +599,21 @@ class GcsServer:
                          overwrite: bool = True) -> bool:
         k = (ns, key)
         if not overwrite and k in self.kv:
-            return False
+            # Idempotent for client retries across GCS restarts: replaying
+            # the same first-write succeeds; a genuine conflict still fails.
+            return self.kv[k] == value
         self.kv[k] = value
+        self._mark_dirty()
         return True
 
     async def rpc_kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
         return self.kv.get((ns, key))
 
     async def rpc_kv_del(self, ns: str, key: bytes) -> bool:
-        return self.kv.pop((ns, key), None) is not None
+        existed = self.kv.pop((ns, key), None) is not None
+        if existed:
+            self._mark_dirty()
+        return existed
 
     async def rpc_kv_exists(self, ns: str, key: bytes) -> bool:
         return (ns, key) in self.kv
@@ -572,10 +643,12 @@ class GcsServer:
 
     async def rpc_subscribe(self, channel: str, addr: Address) -> dict:
         self.subscribers[channel].add(tuple(addr))
+        self._mark_dirty()
         return {"ok": True}
 
     async def rpc_unsubscribe(self, channel: str, addr: Address) -> dict:
         self.subscribers[channel].discard(tuple(addr))
+        self._mark_dirty()
         return {"ok": True}
 
     async def rpc_publish(self, channel: str, message: Any) -> dict:
@@ -601,15 +674,48 @@ class GcsServer:
             return os.path.join(self.cfg.gcs_file_storage_path, "gcs_snapshot.pkl")
         return None
 
+    def _mark_dirty(self):
+        self._dirty = True
+
+    async def _snapshot_loop(self):
+        """Debounced persistence: at most one snapshot per period
+        (ref: Redis-backed GcsTableStorage writes per-mutation; a periodic
+        whole-state snapshot gives the same restart guarantee here)."""
+        while not self._stopping:
+            await asyncio.sleep(0.5)
+            if self._dirty:
+                self._dirty = False
+                await self._snapshot_async()
+
+    def _snapshot_bytes(self) -> bytes:
+        return pickle.dumps({"kv": self.kv, "named_actors": self.named_actors,
+                             "jobs": self.jobs, "actors": self.actors,
+                             "pgs": self.pgs,
+                             "subscribers": dict(self.subscribers)})
+
+    def _write_snapshot(self, path: str, data: bytes):
+        with open(path + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(path + ".tmp", path)
+
     def _maybe_snapshot(self):
         path = self._snapshot_path()
         if not path:
             return
         try:
-            with open(path + ".tmp", "wb") as f:
-                pickle.dump({"kv": self.kv, "named_actors": self.named_actors,
-                             "jobs": self.jobs}, f)
-            os.replace(path + ".tmp", path)
+            self._write_snapshot(path, self._snapshot_bytes())
+        except Exception:
+            logger.exception("gcs snapshot failed")
+
+    async def _snapshot_async(self):
+        """Pickle on the loop (consistent state view), write off-loop so
+        heartbeats/leases aren't blocked behind disk I/O."""
+        path = self._snapshot_path()
+        if not path:
+            return
+        try:
+            data = self._snapshot_bytes()
+            await asyncio.to_thread(self._write_snapshot, path, data)
         except Exception:
             logger.exception("gcs snapshot failed")
 
@@ -623,7 +729,12 @@ class GcsServer:
             self.kv = data.get("kv", {})
             self.named_actors = data.get("named_actors", {})
             self.jobs = data.get("jobs", {})
-            logger.info("gcs restored %d kv entries", len(self.kv))
+            self.actors = data.get("actors", {})
+            self.pgs = data.get("pgs", {})
+            for ch, addrs in data.get("subscribers", {}).items():
+                self.subscribers[ch] |= set(addrs)
+            logger.info("gcs restored %d kv entries, %d actors, %d pgs",
+                        len(self.kv), len(self.actors), len(self.pgs))
         except Exception:
             logger.exception("gcs restore failed")
 

@@ -55,6 +55,7 @@ class WorkerRecord:
         self.last_idle = time.time()
         self.lease_time = 0.0          # when the current lease was granted
         self.retriable = True          # current task retries on worker death
+        self.actor_id = None           # set when this worker hosts an actor
         self.ready = asyncio.Event()
 
 
@@ -116,6 +117,7 @@ class Nodelet:
         info = NodeInfo(node_id=self.node_id, nodelet_addr=addr,
                         resources_total=self.total, labels=self.labels,
                         store_name=self.store_name)
+        self._node_info = info
         gcs = self.pool.get(self.gcs_addr)
         r = await gcs.call("register_node", info=info,
                            timeout=self.cfg.rpc_connect_timeout_s)
@@ -142,9 +144,18 @@ class Nodelet:
         while not self._stopping:
             self._hb_seq += 1
             try:
-                await gcs.call("heartbeat", node_id=self.node_id, seqno=self._hb_seq,
-                               available=self.available,
-                               pending_leases=len(self.pending), timeout=5.0)
+                r = await gcs.call("heartbeat", node_id=self.node_id,
+                                   seqno=self._hb_seq,
+                                   available=self.available,
+                                   pending_leases=len(self.pending),
+                                   timeout=5.0)
+                if r.get("reregister"):
+                    # GCS restarted without membership (fresh or restored
+                    # snapshot): re-announce this node, including the actors
+                    # it hosts, so the control plane rebuilds its view
+                    # without double-creating (ref: GCS failover).
+                    await gcs.call("register_node", info=self._node_info,
+                                   hosted=self._hosted_actors(), timeout=5.0)
             except (ConnectionLost, RemoteError, OSError):
                 pass
             await asyncio.sleep(period)
@@ -259,13 +270,27 @@ class Nodelet:
             except RuntimeError:
                 pass
 
+    def _hosted_actors(self) -> dict:
+        return {w.actor_id.hex(): {"addr": w.addr, "worker_id": w.worker_id}
+                for w in self.workers.values()
+                if w.state == "actor" and w.actor_id is not None
+                and w.addr is not None}
+
     async def _report_worker_death(self, w: WorkerRecord, reason: str):
-        try:
-            await self.pool.get(self.gcs_addr).call(
-                "report_worker_death", worker_id=w.worker_id,
-                node_id=self.node_id, reason=reason, timeout=5.0)
-        except Exception:
-            pass
+        # Durable best-effort: the GCS may be mid-restart; keep retrying
+        # through the failover window so actor FSMs see the death
+        # (ref: raylet death reports + GCS reconnect).
+        deadline = time.time() + self.cfg.gcs_reconnect_timeout_s
+        while not self._stopping:
+            try:
+                await self.pool.get(self.gcs_addr).call(
+                    "report_worker_death", worker_id=w.worker_id,
+                    node_id=self.node_id, reason=reason, timeout=5.0)
+                return
+            except Exception:
+                if time.time() >= deadline:
+                    return
+                await asyncio.sleep(0.5)
 
     async def _memory_monitor_loop(self):
         """Kill a worker when host memory crosses the threshold
@@ -465,6 +490,7 @@ class Nodelet:
         w = self.leases[r["lease_id"]]
         w.state = "actor"
         w.job_id = spec.job_id.binary()
+        w.actor_id = spec.actor_id
         client = self.pool.get(tuple(w.addr))
         try:
             res = await client.call("create_actor", spec=spec,

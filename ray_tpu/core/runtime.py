@@ -220,9 +220,19 @@ class Runtime:
 
     def gcs_call(self, method: str, rpc_timeout: Optional[float] = 60.0, **kw):
         """kw may itself contain a `timeout` destined for the handler;
-        `rpc_timeout` is the transport deadline."""
-        return self._run(
-            self.pool.get(self.gcs_addr).call(method, timeout=rpc_timeout, **kw))
+        `rpc_timeout` is the transport deadline.
+
+        Retries across GCS restarts (ref: GcsClient auto-reconnect,
+        _raylet.pyx:2111 _auto_reconnect) until gcs_reconnect_timeout_s."""
+        deadline = time.time() + self.cfg.gcs_reconnect_timeout_s
+        while True:
+            try:
+                return self._run(self.pool.get(self.gcs_addr).call(
+                    method, timeout=rpc_timeout, **kw))
+            except (ConnectionLost, OSError):
+                if self._shutdown or time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
 
     def kv_put(self, ns: str, key: bytes, value: bytes, overwrite: bool = True) -> bool:
         return self.gcs_call("kv_put", ns=ns, key=key, value=value, overwrite=overwrite)
