@@ -14,17 +14,29 @@ the policy update is one jitted SPMD step on the TPU mesh.
         metrics = trainer.train()
 """
 
+from ray_tpu.rl.a2c import A2CConfig, A2CTrainer
 from ray_tpu.rl.core import Algorithm, ReplayActor, ReplayBuffer
 from ray_tpu.rl.dqn import DQNConfig, DQNTrainer
 from ray_tpu.rl.impala import ImpalaConfig, ImpalaTrainer
+from ray_tpu.rl.learner import Learner, LearnerGroup, LearnerSpec
+from ray_tpu.rl.multi_agent import (MultiAgentEnv, MultiAgentPPOConfig,
+                                    MultiAgentPPOTrainer,
+                                    register_multi_agent_env)
+from ray_tpu.rl.offline import BCConfig, BCTrainer, CQLConfig, CQLTrainer
 from ray_tpu.rl.ppo import PPOConfig, PPOTrainer
 from ray_tpu.rl.sac import SACConfig, SACTrainer
+from ray_tpu.rl.td3 import TD3Config, TD3Trainer
 
 _REGISTRY = {
     "PPO": (PPOConfig, PPOTrainer),
     "DQN": (DQNConfig, DQNTrainer),
     "SAC": (SACConfig, SACTrainer),
     "IMPALA": (ImpalaConfig, ImpalaTrainer),
+    "TD3": (TD3Config, TD3Trainer),
+    "A2C": (A2CConfig, A2CTrainer),
+    "BC": (BCConfig, BCTrainer),
+    "CQL": (CQLConfig, CQLTrainer),
+    "MultiAgentPPO": (MultiAgentPPOConfig, MultiAgentPPOTrainer),
 }
 
 
@@ -41,4 +53,9 @@ __all__ = [
     "Algorithm", "ReplayBuffer", "ReplayActor", "get_algorithm",
     "PPOConfig", "PPOTrainer", "DQNConfig", "DQNTrainer",
     "SACConfig", "SACTrainer", "ImpalaConfig", "ImpalaTrainer",
+    "TD3Config", "TD3Trainer", "A2CConfig", "A2CTrainer",
+    "BCConfig", "BCTrainer", "CQLConfig", "CQLTrainer",
+    "MultiAgentEnv", "MultiAgentPPOConfig", "MultiAgentPPOTrainer",
+    "register_multi_agent_env",
+    "Learner", "LearnerGroup", "LearnerSpec",
 ]

@@ -1,0 +1,127 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference: rllib_contrib a2c (rllib/algorithms/a2c before its exile to
+rllib_contrib/) — synchronous rollouts from a worker fleet, a single
+policy-gradient update per batch with a value baseline and entropy bonus.
+Reuses PPO's discrete policy net, rollout worker, and GAE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import Algorithm, probe_env_spec
+from ray_tpu.rl.ppo import (RolloutWorker, compute_gae, init_policy,
+                            policy_forward)
+
+
+@dataclass
+class A2CConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 100
+    lr: float = 7e-4
+    gamma: float = 0.99
+    lam: float = 1.0                 # A2C default: plain n-step returns
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 0.5
+    hidden: int = 64
+    seed: int = 0
+
+
+class A2CTrainer(Algorithm):
+    """ref: rllib_contrib a2c training_step — one synchronous gradient
+    step per collected batch (no minibatch epochs, unlike PPO)."""
+
+    def _setup(self, cfg: A2CConfig):
+        import jax
+        import optax
+
+        obs_dim, n_actions, _a, _h = probe_env_spec(cfg.env, cfg.env_config)
+        assert n_actions is not None, "A2C here supports discrete actions"
+        self.params = init_policy(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                  n_actions, cfg.hidden)
+        self.opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                               optax.rmsprop(cfg.lr, decay=0.99, eps=1e-5))
+        self.opt_state = self.opt.init(self.params)
+        self.workers = [
+            RolloutWorker.options(num_cpus=0.5).remote(
+                cfg.env, cfg.seed + i * 1000, cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(params, mb):
+            logits, values = policy_forward(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=-1)[:, 0]
+            pg_loss = -(logp * mb["adv"]).mean()
+            vf_loss = jnp.square(values - mb["returns"]).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = (pg_loss + cfg.vf_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            upd, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, upd)
+            return params, opt_state, {"loss": loss, **aux}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        params_host = jax.device_get(self.params)
+        batches = ray_tpu.get([
+            w.sample.remote(params_host, cfg.rollout_fragment_length)
+            for w in self.workers])
+        obs, actions, advs, rets = [], [], [], []
+        for b in batches:
+            adv, ret = compute_gae(b, cfg.gamma, cfg.lam)
+            obs.append(b["obs"])
+            actions.append(b["actions"])
+            advs.append(adv)
+            rets.append(ret)
+        adv = np.concatenate(advs)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        mb = {"obs": np.concatenate(obs),
+              "actions": np.concatenate(actions),
+              "adv": adv, "returns": np.concatenate(rets)}
+        self.timesteps += len(mb["adv"])
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, mb)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = weights

@@ -90,9 +90,33 @@ class EnvSampler:
         return prev, float(rew), bool(term), bool(trunc), successor
 
     def episode_stats(self) -> Dict[str, float]:
-        rets = self.completed[-20:]
-        return {"episodes": len(self.completed),
-                "mean_return": float(np.mean(rets)) if rets else 0.0}
+        return episode_stats_from(self.completed)
+
+    def sample_transitions(self, select_action,
+                           num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect an off-policy transition batch
+        {obs, actions, rewards, dones, next_obs}; action choice is the
+        only per-algorithm part (shared by the SAC/TD3 workers)."""
+        obs_b, act_b, rew_b, done_b, nobs_b = [], [], [], [], []
+        for _ in range(num_steps):
+            action = select_action(self.obs)
+            prev, rew, term, _trunc, nobs = self.step_env(action)
+            obs_b.append(np.asarray(prev, np.float32))
+            act_b.append(np.asarray(action, np.float32))
+            rew_b.append(rew)
+            done_b.append(float(term))
+            nobs_b.append(np.asarray(nobs, np.float32))
+        return {"obs": np.stack(obs_b), "actions": np.stack(act_b),
+                "rewards": np.asarray(rew_b, np.float32),
+                "dones": np.asarray(done_b, np.float32),
+                "next_obs": np.stack(nobs_b)}
+
+
+def episode_stats_from(completed: List[float]) -> Dict[str, float]:
+    """Windowed episode-return stats shared by every rollout worker."""
+    rets = completed[-20:]
+    return {"episodes": len(completed),
+            "mean_return": float(np.mean(rets)) if rets else 0.0}
 
 
 # --- replay buffer -----------------------------------------------------------

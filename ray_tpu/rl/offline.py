@@ -1,0 +1,300 @@
+"""Offline RL: behavior cloning (BC) and conservative Q-learning (CQL).
+
+Reference: rllib/algorithms/bc/ (supervised policy learning from a
+recorded dataset, the MARWIL base with beta=0) and rllib/algorithms/cql/
+(SAC base + conservative regularizer penalizing out-of-distribution
+actions; CQL(H) variant with logsumexp over sampled actions). rllib reads
+offline data through ray.data JSON readers (rllib/offline/); here the
+dataset is a dict of arrays or a ray_tpu.data.Dataset of transition rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl.core import Algorithm, mlp_forward, mlp_init
+from ray_tpu.rl.sac import actor_dist, init_sac_nets, q_value, sample_action
+
+
+def _as_transition_arrays(dataset) -> Dict[str, np.ndarray]:
+    """Accept {col: array} or a ray_tpu.data.Dataset of row dicts
+    (ref: rllib/offline/json_reader.py feeding SampleBatches)."""
+    if isinstance(dataset, dict):
+        return {k: np.asarray(v) for k, v in dataset.items()}
+    from ray_tpu.data.dataset import Dataset
+
+    if isinstance(dataset, Dataset):
+        import pandas as pd  # noqa: F401  (to_pandas uses it)
+
+        df = dataset.to_pandas()
+        return {c: np.stack(df[c].to_numpy()) for c in df.columns}
+    raise TypeError(f"unsupported offline dataset type {type(dataset)}")
+
+
+class _OfflineMixin:
+    """Minibatch plumbing shared by the offline trainers."""
+
+    def _init_data(self, dataset, batch_size: int, seed: int):
+        self.data = _as_transition_arrays(dataset)
+        self.n = len(next(iter(self.data.values())))
+        self.batch_size = min(batch_size, self.n)
+        self._rng = np.random.default_rng(seed)
+
+    def _minibatch(self) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self.n, self.batch_size)
+        return {k: v[idx] for k, v in self.data.items()}
+
+
+@dataclass
+class BCConfig:
+    dataset: Any = None              # {"obs", "actions"} or data.Dataset
+    discrete: bool = True
+    obs_dim: int = 0                 # inferred from data when 0
+    n_actions: int = 0               # discrete head size
+    act_dim: int = 0                 # continuous head size
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    updates_per_iter: int = 32
+    hidden: int = 128
+    seed: int = 0
+
+
+class BCTrainer(_OfflineMixin, Algorithm):
+    """Behavior cloning (ref: rllib/algorithms/bc/bc.py — MARWIL beta=0):
+    cross-entropy on discrete actions, Gaussian NLL on continuous."""
+
+    def _setup(self, cfg: BCConfig):
+        import jax
+        import optax
+
+        assert cfg.dataset is not None, "BC needs an offline dataset"
+        self._init_data(cfg.dataset, cfg.train_batch_size, cfg.seed)
+        obs_dim = cfg.obs_dim or int(self.data["obs"].shape[-1])
+        if cfg.discrete:
+            n_out = cfg.n_actions or int(self.data["actions"].max()) + 1
+        else:
+            n_out = 2 * (cfg.act_dim or int(self.data["actions"].shape[-1]))
+        self.params = mlp_init(jax.random.PRNGKey(cfg.seed),
+                               [obs_dim, cfg.hidden, cfg.hidden, n_out],
+                               out_scale=0.01)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.workers = []
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(params, mb):
+            out = mlp_forward(params, mb["obs"])
+            if cfg.discrete:
+                logp = jax.nn.log_softmax(out)
+                nll = -jnp.take_along_axis(
+                    logp, mb["actions"][:, None].astype(jnp.int32),
+                    axis=-1).mean()
+                acc = (out.argmax(-1) == mb["actions"]).mean()
+                return nll, {"accuracy": acc}
+            mu, log_std = jnp.split(out, 2, axis=-1)
+            log_std = jnp.clip(log_std, -5.0, 2.0)
+            nll = (0.5 * jnp.square((mb["actions"] - mu)
+                                    / jnp.exp(log_std))
+                   + log_std).sum(-1).mean()
+            return nll, {"mse": jnp.square(mu - mb["actions"]).mean()}
+
+        def update(params, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            upd, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, upd), opt_state, \
+                {"loss": loss, **aux}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        aux = {}
+        for _ in range(self.config.updates_per_iter):
+            self.params, self.opt_state, aux = self._update(
+                self.params, self.opt_state, self._minibatch())
+        return {"num_samples": self.n,
+                **{k: float(v) for k, v in aux.items()}}
+
+    def compute_action(self, obs):
+        import jax.numpy as jnp
+
+        out = np.asarray(mlp_forward(self.params, jnp.asarray(obs)[None]))[0]
+        if self.config.discrete:
+            return int(out.argmax())
+        return out[:out.shape[-1] // 2]
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = weights
+
+
+@dataclass
+class CQLConfig:
+    dataset: Any = None  # {"obs","actions","rewards","dones","next_obs"}
+    act_high: float = 1.0
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    alpha: float = 0.2               # SAC entropy weight (fixed here)
+    cql_weight: float = 1.0          # conservative penalty weight
+    cql_n_actions: int = 4           # sampled actions for the logsumexp
+    train_batch_size: int = 128
+    updates_per_iter: int = 32
+    hidden: int = 128
+    seed: int = 0
+
+
+class CQLTrainer(_OfflineMixin, Algorithm):
+    """CQL(H) on the SAC machinery (ref: rllib/algorithms/cql/cql.py —
+    SAC losses + min_q regularizer: logsumexp over random/policy actions
+    minus the dataset action's Q)."""
+
+    def _setup(self, cfg: CQLConfig):
+        import jax
+        import optax
+
+        assert cfg.dataset is not None, "CQL needs an offline dataset"
+        self._init_data(cfg.dataset, cfg.train_batch_size, cfg.seed)
+        obs_dim = int(self.data["obs"].shape[-1])
+        act_dim = int(self.data["actions"].shape[-1])
+        self.nets = init_sac_nets(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                  act_dim, cfg.hidden)
+        self.target_q = jax.tree_util.tree_map(
+            lambda x: x, {"q1": self.nets["q1"], "q2": self.nets["q2"]})
+        self.critic_opt = optax.adam(cfg.lr)
+        self.actor_opt = optax.adam(cfg.lr)
+        self.critic_os = self.critic_opt.init(
+            {"q1": self.nets["q1"], "q2": self.nets["q2"]})
+        self.actor_os = self.actor_opt.init(self.nets["actor"])
+        self.workers = []
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        act_high = cfg.act_high
+
+        def cql_penalty(q_params, obs, pol_a, q_data, key):
+            """logsumexp over (uniform + frozen-policy) actions minus the
+            dataset Q — pushes down OOD action values (CQL eq. 4). The
+            policy actions arrive pre-sampled and stop-gradiented so the
+            penalty only shapes the critics, never the actor."""
+            B = obs.shape[0]
+            rand_a = jax.random.uniform(
+                key, (cfg.cql_n_actions, B, pol_a.shape[-1]),
+                minval=-act_high, maxval=act_high)
+            cat = jnp.concatenate([rand_a, pol_a], 0)       # [2N, B, A]
+            q_all = jax.vmap(lambda a: q_value(q_params, obs, a))(cat)
+            return (jax.scipy.special.logsumexp(q_all, axis=0)
+                    - q_data).mean()
+
+        def update(nets, target_q, critic_os, actor_os, mb, key):
+            """Sequenced like SACTrainer: critic step (actor frozen), then
+            actor step (critics frozen) — a single joint loss would leak
+            actor gradients into the critics and penalty gradients into
+            the actor."""
+            k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+
+            # frozen-policy actions for the penalty's logsumexp
+            pol_a, _ = sample_action(
+                nets["actor"],
+                jnp.broadcast_to(mb["obs"],
+                                 (cfg.cql_n_actions,) + mb["obs"].shape),
+                k2, act_high)
+            pol_a = jax.lax.stop_gradient(pol_a)
+
+            def critic_loss(qs):
+                a_next, logp_next = sample_action(
+                    nets["actor"], mb["next_obs"], k1, act_high)
+                tq = jnp.minimum(
+                    q_value(target_q["q1"], mb["next_obs"], a_next),
+                    q_value(target_q["q2"], mb["next_obs"], a_next))
+                backup = jax.lax.stop_gradient(
+                    mb["rewards"] + cfg.gamma * (1 - mb["dones"])
+                    * (tq - cfg.alpha * logp_next))
+                q1_data = q_value(qs["q1"], mb["obs"], mb["actions"])
+                q2_data = q_value(qs["q2"], mb["obs"], mb["actions"])
+                bellman = (jnp.square(q1_data - backup).mean()
+                           + jnp.square(q2_data - backup).mean())
+                cons = (cql_penalty(qs["q1"], mb["obs"], pol_a, q1_data, k3)
+                        + cql_penalty(qs["q2"], mb["obs"], pol_a, q2_data,
+                                      k4))
+                return bellman + cfg.cql_weight * cons, (bellman, cons)
+
+            qs = {"q1": nets["q1"], "q2": nets["q2"]}
+            (closs, (bellman, cons)), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True)(qs)
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os, qs)
+            qs = optax.apply_updates(qs, cupd)
+            nets = {**nets, "q1": qs["q1"], "q2": qs["q2"]}
+
+            # SAC actor step against the (updated) conservative critics
+            def actor_loss(actor):
+                a_pi, logp_pi = sample_action(actor, mb["obs"], k5, act_high)
+                q_pi = jnp.minimum(q_value(nets["q1"], mb["obs"], a_pi),
+                                   q_value(nets["q2"], mb["obs"], a_pi))
+                return (cfg.alpha * logp_pi - q_pi).mean()
+
+            aloss, agrads = jax.value_and_grad(actor_loss)(nets["actor"])
+            aupd, actor_os = self.actor_opt.update(agrads, actor_os,
+                                                   nets["actor"])
+            nets = {**nets,
+                    "actor": optax.apply_updates(nets["actor"], aupd)}
+            target_q = jax.tree_util.tree_map(
+                lambda t, s: (1 - cfg.tau) * t + cfg.tau * s, target_q,
+                {"q1": nets["q1"], "q2": nets["q2"]})
+            return nets, target_q, critic_os, actor_os, {
+                "loss": closs + aloss, "bellman_loss": bellman,
+                "cql_penalty": cons, "actor_loss": aloss}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        aux = {}
+        for u in range(self.config.updates_per_iter):
+            key = jax.random.PRNGKey(self.iteration * 31337 + u)
+            (self.nets, self.target_q, self.critic_os, self.actor_os,
+             aux) = self._update(self.nets, self.target_q, self.critic_os,
+                                 self.actor_os, self._minibatch(), key)
+        return {"num_samples": self.n,
+                **{k: float(v) for k, v in aux.items()}}
+
+    def compute_action(self, obs, deterministic: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        if deterministic:
+            mu, _ = actor_dist(self.nets["actor"], jnp.asarray(obs)[None])
+            return np.asarray(jnp.tanh(mu))[0] * self.config.act_high
+        self._action_seed = getattr(self, "_action_seed", 0) + 1
+        a, _ = sample_action(self.nets["actor"], jnp.asarray(obs)[None],
+                             jax.random.PRNGKey(self._action_seed),
+                             self.config.act_high)
+        return np.asarray(a)[0]
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
+        self.target_q = jax.tree_util.tree_map(
+            lambda x: x, {"q1": self.nets["q1"], "q2": self.nets["q2"]})

@@ -47,6 +47,15 @@ def policy_forward(params, obs):
     return logits, value
 
 
+def categorical_sample(logits_row: np.ndarray, rng):
+    """Numerically-stable softmax sample -> (action, logp). Shared by the
+    single- and multi-agent rollout workers."""
+    p = np.exp(logits_row - logits_row.max())
+    p = p / p.sum()
+    a = int(rng.choice(len(p), p=p))
+    return a, float(np.log(p[a] + 1e-9))
+
+
 # --- rollout worker (CPU actor) ---------------------------------------------
 
 
@@ -64,11 +73,7 @@ class RolloutWorker(EnvSampler):
         for _ in range(num_steps):
             logits, value = policy_forward(params_host,
                                            jnp.asarray(self.obs)[None])
-            logits = np.asarray(logits)[0]
-            p = np.exp(logits - logits.max())
-            p = p / p.sum()
-            action = int(rng.choice(len(p), p=p))
-            logp = float(np.log(p[action] + 1e-9))
+            action, logp = categorical_sample(np.asarray(logits)[0], rng)
             prev, rew, term, trunc, _nobs = self.step_env(action)
             obs_buf.append(np.asarray(prev, np.float32))
             act_buf.append(action)
@@ -106,6 +111,40 @@ def compute_gae(batch: dict, gamma: float, lam: float):
         next_v = val[t]
     returns = adv + val
     return adv, returns
+
+
+def make_ppo_update(cfg, opt):
+    """Build the (un-jitted) clipped-surrogate update shared by
+    PPOTrainer and MultiAgentPPOTrainer. cfg needs .clip/.vf_coeff/
+    .entropy_coeff; opt is an optax optimizer."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, mb):
+        logits, value = policy_forward(params, mb["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, mb["actions"][:, None],
+                                   axis=-1)[:, 0]
+        ratio = jnp.exp(logp - mb["logp"])
+        adv = mb["adv"]
+        pg = -jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv).mean()
+        vf = 0.5 * jnp.square(value - mb["returns"]).mean()
+        ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+        return total, {"pg_loss": pg, "vf_loss": vf, "entropy": ent}
+
+    def update(params, opt_state, mb):
+        (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, mb)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        aux["total_loss"] = total
+        return params, opt_state, aux
+
+    return update
 
 
 @dataclass
@@ -154,37 +193,7 @@ class PPOTrainer:
         self.iteration = 0
 
     def _make_update(self):
-        import jax
-        import jax.numpy as jnp
-
-        cfg = self.cfg
-
-        def loss_fn(params, mb):
-            logits, value = policy_forward(params, mb["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(logp_all, mb["actions"][:, None],
-                                       axis=-1)[:, 0]
-            ratio = jnp.exp(logp - mb["logp"])
-            adv = mb["adv"]
-            pg = -jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv).mean()
-            vf = 0.5 * jnp.square(value - mb["returns"]).mean()
-            ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
-            return total, {"pg_loss": pg, "vf_loss": vf, "entropy": ent}
-
-        def update(params, opt_state, mb):
-            (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, mb)
-            updates, opt_state = self.opt.update(grads, opt_state, params)
-            import optax
-
-            params = optax.apply_updates(params, updates)
-            aux["total_loss"] = total
-            return params, opt_state, aux
-
-        return update
+        return make_ppo_update(self.cfg, self.opt)
 
     def train(self) -> Dict[str, Any]:
         import jax

@@ -73,25 +73,15 @@ class _SACWorker(EnvSampler):
         import jax
         import jax.numpy as jnp
 
-        obs_b, act_b, rew_b, done_b, nobs_b = [], [], [], [], []
-        for _ in range(num_steps):
+        def select(obs):
             if random_actions:
-                action = self.env.action_space.sample()
-            else:
-                key = jax.random.PRNGKey(self.seed * 100003 + self.steps)
-                a, _ = sample_action(actor, jnp.asarray(self.obs)[None], key,
-                                     self.act_high)
-                action = np.asarray(a)[0]
-            prev, rew, term, _trunc, nobs = self.step_env(action)
-            obs_b.append(np.asarray(prev, np.float32))
-            act_b.append(np.asarray(action, np.float32))
-            rew_b.append(rew)
-            done_b.append(float(term))
-            nobs_b.append(np.asarray(nobs, np.float32))
-        return {"obs": np.stack(obs_b), "actions": np.stack(act_b),
-                "rewards": np.asarray(rew_b, np.float32),
-                "dones": np.asarray(done_b, np.float32),
-                "next_obs": np.stack(nobs_b)}
+                return self.env.action_space.sample()
+            key = jax.random.PRNGKey(self.seed * 100003 + self.steps)
+            a, _ = sample_action(actor, jnp.asarray(obs)[None], key,
+                                 self.act_high)
+            return np.asarray(a)[0]
+
+        return self.sample_transitions(select, num_steps)
 
 
 @dataclass

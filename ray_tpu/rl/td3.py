@@ -1,0 +1,222 @@
+"""TD3: twin-delayed deep deterministic policy gradient.
+
+Reference: rllib/algorithms/td3/ (twin critics, target policy smoothing,
+delayed actor updates over the DDPG base ddpg/ddpg.py). Continuous
+control; CPU rollout actors with Gaussian exploration noise, one jitted
+learner update on the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import (Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
+                             mlp_init, probe_env_spec)
+
+
+def init_td3_nets(key, obs_dim: int, act_dim: int, hidden: int):
+    import jax
+
+    ks = jax.random.split(key, 3)
+    actor = mlp_init(ks[0], [obs_dim, hidden, hidden, act_dim],
+                     out_scale=0.01)
+    q1 = mlp_init(ks[1], [obs_dim + act_dim, hidden, hidden, 1])
+    q2 = mlp_init(ks[2], [obs_dim + act_dim, hidden, hidden, 1])
+    return {"actor": actor, "q1": q1, "q2": q2}
+
+
+def policy_action(actor, obs, act_high: float):
+    import jax.numpy as jnp
+
+    return jnp.tanh(mlp_forward(actor, obs)) * act_high
+
+
+def q_value(q, obs, act):
+    import jax.numpy as jnp
+
+    return mlp_forward(q, jnp.concatenate([obs, act], -1))[..., 0]
+
+
+@ray_tpu.remote
+class _TD3Worker(EnvSampler):
+    def __init__(self, env_name: str, seed: int,
+                 env_config: Optional[dict] = None):
+        super().__init__(env_name, seed, env_config)
+        self.act_high = float(np.asarray(
+            self.env.action_space.high).reshape(-1)[0])
+        self.rng = np.random.default_rng(seed)
+
+    def sample(self, actor, num_steps: int, random_actions: bool,
+               expl_noise: float):
+        import jax.numpy as jnp
+
+        def select(obs):
+            if random_actions:
+                return self.env.action_space.sample()
+            a = policy_action(actor, jnp.asarray(obs)[None], self.act_high)
+            action = np.asarray(a)[0]
+            return np.clip(
+                action + self.rng.normal(
+                    0, expl_noise * self.act_high, action.shape),
+                -self.act_high, self.act_high).astype(np.float32)
+
+        return self.sample_transitions(select, num_steps)
+
+
+@dataclass
+class TD3Config:
+    env: str = "Pendulum-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 1
+    rollout_fragment_length: int = 100
+    replay_capacity: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    updates_per_iter: int = 32
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    policy_delay: int = 2            # delayed actor updates (TD3 trick #2)
+    target_noise: float = 0.2        # target policy smoothing (trick #3)
+    target_noise_clip: float = 0.5
+    exploration_noise: float = 0.1
+    hidden: int = 128
+    seed: int = 0
+
+
+class TD3Trainer(Algorithm):
+    """ref: rllib/algorithms/td3/td3.py (DDPG base + TD3 tricks)."""
+
+    def _setup(self, cfg: TD3Config):
+        import jax
+        import optax
+
+        obs_dim, _n, act_dim, act_high = probe_env_spec(
+            cfg.env, cfg.env_config)
+        assert act_dim is not None, "TD3 needs a continuous action space"
+        self.act_high = act_high or 1.0
+        self.nets = init_td3_nets(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                  act_dim, cfg.hidden)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.nets)
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.actor_os = self.actor_opt.init(self.nets["actor"])
+        self.critic_os = self.critic_opt.init(
+            {"q1": self.nets["q1"], "q2": self.nets["q2"]})
+        self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
+        self.workers = [
+            _TD3Worker.options(num_cpus=0.5).remote(
+                cfg.env, cfg.seed + i * 1000, cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self.num_updates = 0
+        self._update = jax.jit(self._make_update(), static_argnames="do_actor")
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        act_high = self.act_high
+
+        def update(nets, target, actor_os, critic_os, mb, key,
+                   do_actor: bool):
+            # --- twin critics with target policy smoothing
+            def critic_loss(qs):
+                noise = jnp.clip(
+                    jax.random.normal(key, mb["actions"].shape)
+                    * cfg.target_noise,
+                    -cfg.target_noise_clip, cfg.target_noise_clip)
+                a_next = jnp.clip(
+                    policy_action(target["actor"], mb["next_obs"], act_high)
+                    + noise * act_high, -act_high, act_high)
+                tq = jnp.minimum(
+                    q_value(target["q1"], mb["next_obs"], a_next),
+                    q_value(target["q2"], mb["next_obs"], a_next))
+                backup = jax.lax.stop_gradient(
+                    mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * tq)
+                l1 = jnp.square(q_value(qs["q1"], mb["obs"], mb["actions"])
+                                - backup).mean()
+                l2 = jnp.square(q_value(qs["q2"], mb["obs"], mb["actions"])
+                                - backup).mean()
+                return l1 + l2
+
+            qs = {"q1": nets["q1"], "q2": nets["q2"]}
+            closs, cgrads = jax.value_and_grad(critic_loss)(qs)
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os, qs)
+            qs = optax.apply_updates(qs, cupd)
+            nets = {**nets, "q1": qs["q1"], "q2": qs["q2"]}
+
+            # --- delayed deterministic actor + polyak (only every
+            #     policy_delay updates; staticly compiled both ways)
+            def actor_loss(actor):
+                a = policy_action(actor, mb["obs"], act_high)
+                return -q_value(nets["q1"], mb["obs"], a).mean()
+
+            if do_actor:
+                aloss, agrads = jax.value_and_grad(actor_loss)(nets["actor"])
+                aupd, actor_os = self.actor_opt.update(agrads, actor_os,
+                                                       nets["actor"])
+                nets = {**nets,
+                        "actor": optax.apply_updates(nets["actor"], aupd)}
+                target = jax.tree_util.tree_map(
+                    lambda t, s: (1 - cfg.tau) * t + cfg.tau * s,
+                    target, nets)
+            else:
+                aloss = jnp.zeros(())
+            return nets, target, actor_os, critic_os, {
+                "critic_loss": closs, "actor_loss": aloss}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        actor_host = jax.device_get(self.nets["actor"])
+        warmup = self.timesteps < cfg.learning_starts
+        refs = [w.sample.remote(actor_host, cfg.rollout_fragment_length,
+                                warmup, cfg.exploration_noise)
+                for w in self.workers]
+        for b in ray_tpu.get(refs):
+            self.buffer.add_batch(b)
+            self.timesteps += len(b["rewards"])
+
+        aux = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for u in range(cfg.updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                key = jax.random.PRNGKey(self.iteration * 99991 + u)
+                self.num_updates += 1
+                (self.nets, self.target, self.actor_os, self.critic_os,
+                 aux) = self._update(
+                    self.nets, self.target, self.actor_os, self.critic_os,
+                    mb, key,
+                    do_actor=self.num_updates % cfg.policy_delay == 0)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "num_updates": self.num_updates,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "buffer_size": len(self.buffer),
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, self.nets)

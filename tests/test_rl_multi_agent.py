@@ -1,0 +1,134 @@
+"""Multi-agent RL + Learner/LearnerGroup.
+
+Reference test model: rllib/tests/test_multi_agent_env.py (dict
+in/out, per-policy batches, "__all__" termination) and
+rllib/core/learner/tests (update moves weights, group replicas stay in
+sync).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_multi_agent_env_protocol():
+    from ray_tpu.rl.multi_agent import ContextMatchEnv
+
+    env = ContextMatchEnv(n_context=3, episode_len=2, seed=0)
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {"a", "b"}
+    assert obs["a"].shape == (3,) and obs["a"].sum() == 1.0
+    ctx = {aid: int(o.argmax()) for aid, o in obs.items()}
+    obs, rew, term, trunc, _ = env.step(ctx)
+    assert rew["a"] == 1.0 and rew["b"] >= 1.0
+    assert term["__all__"] is False
+    _, _, term, _, _ = env.step({"a": 0, "b": 0})
+    assert term["__all__"] is True
+
+
+def test_multi_agent_ppo_learns(cluster):
+    from ray_tpu.rl import MultiAgentPPOConfig, MultiAgentPPOTrainer
+
+    cfg = MultiAgentPPOConfig(num_rollout_workers=2,
+                              rollout_fragment_length=128,
+                              minibatch_size=64, lr=1e-2, seed=0)
+    t = MultiAgentPPOTrainer(cfg)
+    try:
+        r = None
+        for _ in range(8):
+            r = t.train()
+        # both policies trained, losses finite
+        assert set(r["policies"]) == {"a", "b"}
+        for aux in r["policies"].values():
+            assert np.isfinite(aux["total_loss"])
+        # context_match is learnable: greedy actions should match context
+        obs = {"a": np.eye(4, dtype=np.float32)[2],
+               "b": np.eye(4, dtype=np.float32)[1]}
+        acts = t.compute_actions(obs)
+        assert acts["a"] == 2 and acts["b"] == 1
+        # episode return trends up (max is ~37.5/ep for len-25 episodes)
+        assert r["episode_return_mean"] > 25
+    finally:
+        t.stop()
+
+
+def test_multi_agent_shared_policy(cluster):
+    """Two agents mapped onto ONE shared policy (rllib's param-sharing
+    pattern via policy_mapping_fn)."""
+    from ray_tpu.rl import MultiAgentPPOConfig, MultiAgentPPOTrainer
+
+    cfg = MultiAgentPPOConfig(
+        policy_mapping={"a": "shared", "b": "shared"},
+        num_rollout_workers=1, rollout_fragment_length=64, seed=1)
+    t = MultiAgentPPOTrainer(cfg)
+    try:
+        r = t.train()
+        assert list(r["policies"]) == ["shared"]
+        assert set(t.get_weights()) == {"shared"}
+    finally:
+        t.stop()
+
+
+def _spec(lr=1e-1):
+    from ray_tpu.rl import LearnerSpec
+
+    def init_fn(key):
+        import jax
+
+        return {"w": jax.random.normal(key, (4, 1))}
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+
+        pred = batch["x"] @ params["w"]
+        return jnp.square(pred[:, 0] - batch["y"]).mean()
+
+    return LearnerSpec(init_fn=init_fn, loss_fn=loss_fn, lr=lr,
+                       grad_clip=10.0, seed=0)
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    w_true = np.asarray([1.0, -2.0, 0.5, 0.0], np.float32)
+    return {"x": x, "y": x @ w_true}
+
+
+def test_learner_update_converges():
+    from ray_tpu.rl import Learner
+
+    lrn = Learner(_spec())
+    batch = _data()
+    losses = [lrn.update(batch) for _ in range(60)]
+    assert losses[-1] < 0.05 * losses[0]
+    st = lrn.get_state()
+    lrn2 = Learner(_spec())
+    lrn2.set_state(st)
+    assert np.allclose(lrn2.get_weights()["w"], lrn.get_weights()["w"])
+
+
+def test_learner_group_ddp_equivalence(cluster):
+    """Group replicas stay bit-identical and converge
+    (ref: learner_group DDP semantics)."""
+    from ray_tpu.rl import LearnerGroup
+
+    g = LearnerGroup(_spec(), num_learners=2, num_cpus_per_learner=0.5)
+    try:
+        batch = _data(n=64)
+        first = g.update(batch)
+        for _ in range(40):
+            last = g.update(batch)
+        assert last < 0.1 * first
+        # replicas in sync after many updates
+        states = ray_tpu.get([a.get_weights.remote() for a in g._actors])
+        assert np.allclose(states[0]["w"], states[1]["w"])
+    finally:
+        g.shutdown()
