@@ -22,15 +22,19 @@ separate trainer classes.
     result = JaxTrainer(train_loop, scaling_config=ScalingConfig(...)).fit()
 """
 
+from ray_tpu.train.backend import (Backend, JaxBackend, TorchBackend,
+                                   prepare_data_loader, prepare_model)
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.train.trainer import JaxTrainer, Result
+from ray_tpu.train.trainer import JaxTrainer, Result, TorchTrainer
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train import session
 
 __all__ = [
-    "JaxTrainer", "Result", "ScalingConfig", "RunConfig", "FailureConfig",
-    "CheckpointConfig", "Checkpoint", "session", "Predictor", "JaxPredictor",
-    "BatchPredictor",
+    "JaxTrainer", "TorchTrainer", "Result", "ScalingConfig", "RunConfig",
+    "FailureConfig", "CheckpointConfig", "Checkpoint", "session",
+    "Predictor", "JaxPredictor", "BatchPredictor",
+    "Backend", "JaxBackend", "TorchBackend", "prepare_model",
+    "prepare_data_loader",
 ]

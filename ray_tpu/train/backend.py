@@ -1,0 +1,121 @@
+"""Pluggable collective backends for the WorkerGroup.
+
+Reference: python/ray/train/_internal/backend_executor.py (Backend's
+on_start/on_training_start hooks run per framework) and the per-framework
+configs: torch (train/torch/config.py:29,69 — init_process_group with a
+rank-0 TCP rendezvous), tensorflow (TF_CONFIG), horovod (Gloo rendezvous).
+
+TPU-native inversion: the primary backend is JAX, where collectives live
+INSIDE the jitted program (XLA over ICI) and the backend's only job is
+bootstrapping jax.distributed across hosts. The TorchBackend exists for
+reference-parity workloads (CPU gloo here; a torch/XLA variant would slot
+in the same hook) so torch users migrating from the reference keep their
+DDP train loops unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Backend:
+    """Worker-side collective bootstrap hooks. Instances are pickled to
+    workers, so keep them stateless/config-only."""
+
+    #: backends that need a rendezvous address even for world_size == 1
+    needs_coordinator: bool = False
+
+    def on_worker_setup(self, rank: int, world_size: int,
+                        coordinator: Optional[str]) -> None:
+        """Runs inside every worker before the train loop."""
+
+    def on_worker_shutdown(self) -> None:
+        """Runs inside every worker after the loop (best-effort)."""
+
+
+class JaxBackend(Backend):
+    """Bring up the jax distributed runtime so all hosts of the slice form
+    one XLA computation domain (replaces _setup_torch_process_group,
+    train/torch/config.py:69 — but collectives themselves come from the
+    compiled program, not a process group)."""
+
+    def on_worker_setup(self, rank, world_size, coordinator):
+        if coordinator and world_size > 1:
+            import jax
+
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=world_size,
+                                       process_id=rank)
+
+
+class TorchBackend(Backend):
+    """torch.distributed gloo process group (ref: train/torch/config.py:69
+    _setup_torch_process_group; nccl is GPU-only — on this stack the
+    device path is JAX/XLA, torch runs host-side)."""
+
+    needs_coordinator = True
+
+    def __init__(self, backend: str = "gloo", timeout_s: float = 120.0):
+        self.backend = backend
+        self.timeout_s = timeout_s
+
+    def on_worker_setup(self, rank, world_size, coordinator):
+        import datetime
+
+        import torch.distributed as dist
+
+        if dist.is_initialized():
+            return
+        dist.init_process_group(
+            backend=self.backend,
+            init_method=f"tcp://{coordinator}",
+            rank=rank, world_size=world_size,
+            timeout=datetime.timedelta(seconds=self.timeout_s))
+
+    def on_worker_shutdown(self):
+        import torch.distributed as dist
+
+        if dist.is_initialized():
+            dist.destroy_process_group()
+
+
+def prepare_model(model):
+    """Wrap an nn.Module in DDP when a >1-rank group is live
+    (ref: ray.train.torch.prepare_model)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Shard a DataLoader across ranks via DistributedSampler, preserving
+    the original loader's shuffle semantics and worker/memory options
+    (ref: ray.train.torch.prepare_data_loader, which inspects the existing
+    sampler to decide shuffling)."""
+    import torch.distributed as dist
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return loader
+    if loader.batch_size is None:
+        raise ValueError(
+            "prepare_data_loader supports batch_size-based DataLoaders; "
+            "pass your custom batch_sampler a DistributedSampler yourself")
+    from torch.utils.data import DataLoader, SequentialSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    shuffle = not isinstance(loader.sampler, SequentialSampler)
+    return DataLoader(loader.dataset, batch_size=loader.batch_size,
+                      sampler=DistributedSampler(loader.dataset,
+                                                 shuffle=shuffle),
+                      num_workers=loader.num_workers,
+                      pin_memory=loader.pin_memory,
+                      collate_fn=loader.collate_fn,
+                      drop_last=loader.drop_last,
+                      timeout=loader.timeout,
+                      worker_init_fn=loader.worker_init_fn)

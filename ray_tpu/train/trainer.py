@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.core.status import ActorDiedError, ActorUnavailableError, TaskError
+from ray_tpu.train.backend import TorchBackend
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
@@ -34,18 +35,27 @@ class Result:
 
 
 class JaxTrainer:
+    #: collective bootstrap, overridable per subclass
+    #  (ref: DataParallelTrainer's backend_config, data_parallel_trainer.py:58)
+    backend_cls: type = None
+
     def __init__(self, train_loop_per_worker: Callable,
                  *, train_loop_config: Optional[dict] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict[str, Any]] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 backend=None):
+        from ray_tpu.train.backend import JaxBackend
+
         self.loop = train_loop_per_worker
         self.config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from = resume_from_checkpoint
+        self.backend = backend or (self.backend_cls() if self.backend_cls
+                                   else JaxBackend())
 
     def _run_dir(self) -> str:
         base = self.run_config.storage_path or os.path.expanduser(
@@ -85,13 +95,14 @@ class JaxTrainer:
             shards: List[Dict[str, Any]] = _split_datasets(
                 self.datasets, self.scaling.num_workers)
             coordinator = None
-            if self.scaling.num_workers > 1:
+            if self.scaling.num_workers > 1 or self.backend.needs_coordinator:
                 info = ray_tpu.get(group.workers[0].host_info.remote())
-                coordinator = f"{info['hostname']}:{29891}"
+                coordinator = f"{info['hostname']}:{info['free_port']}"
             setup_refs = [
                 w.setup.remote(self.config, run_dir, self.scaling, checkpoint,
                                shards[i], coordinator,
-                               self.run_config.checkpoint_config.num_to_keep)
+                               self.run_config.checkpoint_config.num_to_keep,
+                               self.backend)
                 for i, w in enumerate(group.workers)]
             ray_tpu.get(setup_refs)
             run_refs = [w.run.remote(self.loop, self.config)
@@ -131,6 +142,15 @@ class JaxTrainer:
             return result
         finally:
             group.shutdown()
+
+
+class TorchTrainer(JaxTrainer):
+    """Reference-parity torch trainer (ref: train/torch/torch_trainer.py):
+    same orchestration, TorchBackend gloo process group instead of jax
+    distributed bootstrap. User loops use torch.distributed +
+    ray_tpu.train.prepare_model unchanged."""
+
+    backend_cls = TorchBackend
 
 
 def _latest_checkpoint(run_dir: str) -> Optional[Checkpoint]:

@@ -35,16 +35,19 @@ class TrainWorker:
 
     def setup(self, config: dict, run_dir: str, scaling, checkpoint,
               datasets, coordinator: Optional[str] = None,
-              num_to_keep=None) -> bool:
-        # Multi-host: bring up the jax distributed runtime so all hosts of
-        # the slice form one XLA computation domain (replaces
-        # _setup_torch_process_group, train/torch/config.py:69).
-        if coordinator and self.world_size > 1:
-            import jax
+              num_to_keep=None, backend=None) -> bool:
+        # Collective bootstrap is a pluggable Backend hook
+        # (ref: backend_executor.py Backend.on_start); default JaxBackend.
+        from ray_tpu.train.backend import JaxBackend
 
-            jax.distributed.initialize(coordinator_address=coordinator,
-                                       num_processes=self.world_size,
-                                       process_id=self.rank)
+        # release the rendezvous-port reservation right before the
+        # backend binds it (see host_info)
+        res = getattr(self, "_port_reservation", None)
+        if res is not None:
+            res.close()
+            self._port_reservation = None
+        self.backend = backend or JaxBackend()
+        self.backend.on_worker_setup(self.rank, self.world_size, coordinator)
         self.ctx = TrainContext(
             world_rank=self.rank, world_size=self.world_size, config=config,
             run_dir=run_dir, scaling=scaling, checkpoint=checkpoint,
@@ -64,6 +67,10 @@ class TrainWorker:
         finally:
             if self.ctx is not None:
                 self.ctx.finished = True
+            try:
+                self.backend.on_worker_shutdown()
+            except Exception:
+                pass
 
     def poll(self, after: int) -> dict:
         ctx = self.ctx
@@ -79,8 +86,17 @@ class TrainWorker:
     def host_info(self) -> dict:
         import socket
 
+        # Reserve a rendezvous port and HOLD the socket open until setup()
+        # runs in this same process — concurrent trainers (e.g. Tune
+        # trials) probing for ports can't be handed this one while the
+        # reservation lives, and the close→rebind window is microseconds
+        # inside one process instead of a cross-RPC race.
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        self._port_reservation = s
         return {"hostname": socket.gethostname(), "pid": os.getpid(),
-                "rank": self.rank}
+                "rank": self.rank, "free_port": port}
 
 
 def _accepts_arg(fn) -> bool:
