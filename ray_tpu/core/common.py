@@ -219,9 +219,15 @@ class TaskSpec:
         return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]
 
     def scheduling_class(self) -> Tuple:
-        """Tasks with equal class can reuse a lease (ref: SchedulingClass)."""
+        """Tasks with equal class can reuse a lease (ref: SchedulingClass).
+        Includes the process-env key: leases pin workers whose process env
+        was fixed at spawn, so tasks with different process_env_vars must
+        never share one."""
+        from ray_tpu.runtime_env import process_env
+
+        pe = tuple(sorted(process_env(self.runtime_env).items()))
         return (self.func_id, tuple(sorted(self.resources.quantities.items())),
-                self.scheduling.kind)
+                self.scheduling.kind, pe)
 
 
 @dataclass

@@ -23,6 +23,7 @@ cluster_task_manager.h:42 queue/dispatch/spillback):
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import signal
@@ -45,9 +46,11 @@ logger = logging.getLogger("ray_tpu.nodelet")
 
 
 class WorkerRecord:
-    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen,
+                 env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
+        self.env_key = env_key         # runtime-env pool key ("" = plain)
         self.addr: Optional[Address] = None
         self.state = "starting"        # starting | idle | leased | actor | dead
         self.lease_id: Optional[bytes] = None
@@ -61,12 +64,20 @@ class WorkerRecord:
 
 class _PendingLease:
     def __init__(self, resources: ResourceSet, pg, fut, job_id=None,
-                 retriable=True):
+                 retriable=True, env_vars=None):
         self.resources = resources
         self.pg = pg                   # (pg_id, bundle_index) or None
         self.fut: asyncio.Future = fut
         self.job_id = job_id
         self.retriable = retriable
+        self.env_vars = env_vars       # process_env_vars for the worker
+
+
+def _env_key(env_vars) -> str:
+    """Pool key for a process-env dict ("" = plain pool)."""
+    if not env_vars:
+        return ""
+    return json.dumps(sorted(env_vars.items()))
 
 
 class Nodelet:
@@ -225,7 +236,7 @@ class Nodelet:
 
     # ---------------------------------------------------------------- workers
 
-    async def _start_worker(self) -> Optional[WorkerRecord]:
+    async def _start_worker(self, env_vars=None) -> Optional[WorkerRecord]:
         worker_id = os.urandom(20)
         log_base = os.path.join(self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}")
         os.makedirs(os.path.dirname(log_base), exist_ok=True)
@@ -233,6 +244,11 @@ class Nodelet:
         err = open(log_base + ".err", "ab")
         env = dict(os.environ)
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if env_vars:
+            # runtime-env-keyed pool: these must exist before the worker
+            # interpreter imports anything (JAX_PLATFORMS, XLA_FLAGS, ...)
+            # (ref: worker_pool.h:156 runtime-env-keyed worker pools)
+            env.update(env_vars)
         cmd = [sys.executable, "-m", "ray_tpu.core.worker",
                "--nodelet", f"{self.server.host}:{self.server.port}",
                "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
@@ -243,7 +259,7 @@ class Nodelet:
         proc = subprocess.Popen(cmd, stdout=out, stderr=err, env=env,
                                 start_new_session=True)
         out.close(); err.close()
-        w = WorkerRecord(worker_id, proc)
+        w = WorkerRecord(worker_id, proc, env_key=_env_key(env_vars))
         self.workers[worker_id] = w
         try:
             await asyncio.wait_for(w.ready.wait(), self.cfg.worker_start_timeout_s)
@@ -338,19 +354,38 @@ class Nodelet:
             self._kill_worker(w, reason or "requested")
         return {"ok": True}
 
-    async def _pop_worker(self) -> Optional[WorkerRecord]:
+    async def _pop_worker(self, env_vars=None) -> Optional[WorkerRecord]:
+        """Pop an idle worker from the pool keyed by the process-env hash
+        (ref: worker_pool.h:156 runtime-env-keyed pools). Workers from a
+        different pool are never handed out — their process env was fixed
+        at spawn."""
+        key = _env_key(env_vars)
         for w in self.workers.values():
-            if w.state == "idle":
+            if w.state == "idle" and w.env_key == key:
                 return w
         if len(self.workers) < self.cfg.max_workers_per_node:
-            return await self._start_worker()
-        # Pool saturated: wait for an idle worker.
+            return await self._start_worker(env_vars)
+        # Saturated: evict an idle worker from another pool to make room
+        # (the reference kills idle workers of stale envs under pressure).
+        for w in list(self.workers.values()):
+            if w.state == "idle" and w.env_key != key:
+                self._kill_worker(w, "evicted for runtime-env pool")
+                return await self._start_worker(env_vars)
+        # Otherwise wait for a matching worker to go idle — or for ANY
+        # idle worker we can evict (a lease released mid-wait from another
+        # pool must not stall this request for the full timeout).
         deadline = time.time() + self.cfg.worker_lease_timeout_s
         while time.time() < deadline:
             await asyncio.sleep(0.02)
             for w in self.workers.values():
-                if w.state == "idle":
+                if w.state == "idle" and w.env_key == key:
                     return w
+            if len(self.workers) < self.cfg.max_workers_per_node:
+                return await self._start_worker(env_vars)
+            for w in list(self.workers.values()):
+                if w.state == "idle" and w.env_key != key:
+                    self._kill_worker(w, "evicted for runtime-env pool")
+                    return await self._start_worker(env_vars)
         return None
 
     # ----------------------------------------------------------------- leases
@@ -375,7 +410,8 @@ class Nodelet:
                                 pg: Optional[Tuple] = None,
                                 grant_or_reject: bool = False,
                                 job_id: Optional[bytes] = None,
-                                retriable: bool = True) -> dict:
+                                retriable: bool = True,
+                                env_vars: Optional[dict] = None) -> dict:
         pool = self._resource_pool(pg)
         if pool is None:
             return {"status": "infeasible", "error": "placement group bundle not here"}
@@ -389,7 +425,8 @@ class Nodelet:
             return {"status": "infeasible",
                     "error": f"no node can satisfy {resources.quantities}"}
         if resources.fits_in(pool):
-            return await self._grant(resources, pg, job_id, retriable)
+            return await self._grant(resources, pg, job_id, retriable,
+                                     env_vars)
         if grant_or_reject:
             return {"status": "rejected"}
         # Feasible but busy → try spillback to an idle peer, else queue here
@@ -400,7 +437,8 @@ class Nodelet:
                 return {"status": "spillback", "addr": target["addr"],
                         "node_id": target["node_id"]}
         fut = asyncio.get_running_loop().create_future()
-        self.pending.append(_PendingLease(resources, pg, fut, job_id, retriable))
+        self.pending.append(_PendingLease(resources, pg, fut, job_id,
+                                          retriable, env_vars))
         try:
             return await asyncio.wait_for(fut, self.cfg.worker_lease_timeout_s)
         except asyncio.TimeoutError:
@@ -416,10 +454,11 @@ class Nodelet:
 
     async def _grant(self, resources: ResourceSet, pg: Optional[Tuple],
                      job_id: Optional[bytes] = None,
-                     retriable: bool = True) -> dict:
+                     retriable: bool = True,
+                     env_vars: Optional[dict] = None) -> dict:
         pool = self._resource_pool(pg)
         pool.subtract(resources)
-        w = await self._pop_worker()
+        w = await self._pop_worker(env_vars)
         if w is None:
             pool.add(resources)
             return {"status": "retry", "error": "no worker available"}
@@ -465,7 +504,7 @@ class Nodelet:
             if pool is not None and p.resources.fits_in(pool):
                 async def _do(p=p):
                     r = await self._grant(p.resources, p.pg, p.job_id,
-                                          p.retriable)
+                                          p.retriable, p.env_vars)
                     if not p.fut.done():
                         p.fut.set_result(r)
                 loop.create_task(_do())
@@ -481,9 +520,11 @@ class Nodelet:
         pg = None
         if spec.scheduling.kind == "PLACEMENT_GROUP":
             pg = (spec.scheduling.pg_id, spec.scheduling.bundle_index)
+        from ray_tpu.runtime_env import process_env
+
         r = await self.rpc_request_lease(
             resources=spec.resources, pg=pg, job_id=spec.job_id.binary(),
-            retriable=False)
+            retriable=False, env_vars=process_env(spec.runtime_env))
         if r["status"] != "granted":
             return {"ok": False, "retryable": r["status"] in ("retry", "spillback"),
                     "error": r.get("error", r["status"])}

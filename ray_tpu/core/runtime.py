@@ -43,6 +43,7 @@ from ray_tpu.core.rpc import (ClientPool, ConnectionLost, EventLoopThread,
 from ray_tpu.core.status import (ActorDiedError, ActorUnavailableError,
                                  GetTimeoutError, ObjectLostError, TaskError,
                                  WorkerCrashedError)
+from ray_tpu.runtime_env import process_env as _process_env
 
 logger = logging.getLogger("ray_tpu.runtime")
 
@@ -587,9 +588,10 @@ class Runtime:
             # Task-level overrides job-level per field; env_vars deep-merge
             # with task keys winning (ref: runtime_env merge semantics).
             merged = {**base, **env}
-            if "env_vars" in base or "env_vars" in env:
-                merged["env_vars"] = {**base.get("env_vars", {}),
-                                      **env.get("env_vars", {})}
+            for field in ("env_vars", "process_env_vars"):
+                if field in base or field in env:
+                    merged[field] = {**base.get(field, {}),
+                                     **env.get(field, {})}
         else:
             merged = env if env is not None else base
         if not merged:
@@ -781,6 +783,7 @@ class Runtime:
                     "request_lease", resources=spec.resources, pg=pg,
                     job_id=spec.job_id.binary(),
                     retriable=spec.max_retries != 0,
+                    env_vars=_process_env(spec.runtime_env),
                     timeout=self.cfg.worker_lease_timeout_s + 10.0)
             except (ConnectionLost, RemoteError, OSError) as e:
                 logger.warning("lease request to %s failed: %s", target, e)

@@ -12,7 +12,11 @@ TPU-first simplifications kept deliberate:
   with a clear error instead of silently ignored.
 - Workers apply env specs at task boundaries (env_vars save/restore around
   execution; working_dir/py_modules installed idempotently into a
-  session-scoped cache), rather than keying whole worker pools by env hash.
+  session-scoped cache). The exception is `process_env_vars`: variables
+  that must exist BEFORE the worker interpreter imports anything (e.g.
+  JAX_PLATFORMS, XLA_FLAGS, LIBTPU_INIT_ARGS). Those key dedicated worker
+  pools in the nodelet — the TPU-shaped slice of the reference's
+  runtime-env-keyed pools (worker_pool.h:156).
 """
 
 from __future__ import annotations
@@ -26,7 +30,8 @@ import threading
 import zipfile
 from typing import Any, Dict, List, Optional
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules", "config"}
+_SUPPORTED = {"env_vars", "process_env_vars", "working_dir", "py_modules",
+              "config"}
 _UNSUPPORTED = {"conda", "pip", "container", "image_uri", "java_jars"}
 
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
@@ -46,11 +51,17 @@ def validate(env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     unknown = set(env) - _SUPPORTED
     if unknown:
         raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
-    ev = env.get("env_vars", {})
-    if not all(isinstance(k, str) and isinstance(v, str)
-               for k, v in ev.items()):
-        raise TypeError("runtime_env.env_vars must be Dict[str, str]")
+    for field in ("env_vars", "process_env_vars"):
+        ev = env.get(field, {})
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in ev.items()):
+            raise TypeError(f"runtime_env.{field} must be Dict[str, str]")
     return dict(env)
+
+
+def process_env(env: Optional[Dict[str, Any]]) -> Dict[str, str]:
+    """Vars that must be set before worker start (keys the worker pool)."""
+    return (env or {}).get("process_env_vars", {})
 
 
 # --- packaging (driver side) -------------------------------------------------

@@ -82,3 +82,49 @@ def test_py_modules_on_actor(cluster, tmp_path):
 
     a = A.options(runtime_env={"py_modules": [str(d)]}).remote()
     assert ray_tpu.get(a.get.remote()) == 7
+
+
+def test_process_env_vars_keyed_pool(cluster):
+    """process_env_vars must exist before worker start (pre-import vars
+    like XLA_FLAGS), so they key dedicated worker pools
+    (ref: worker_pool.h:156 runtime-env-keyed pools)."""
+
+    @ray_tpu.remote
+    def probe():
+        # read at execution time, but set at PROCESS SPAWN: a per-task
+        # env patch could not fake a pre-import variable, so also return
+        # the pid to prove pool separation
+        return os.environ.get("RT_POOL_MARK"), os.getpid()
+
+    plain_mark, plain_pid = ray_tpu.get(probe.remote())
+    assert plain_mark is None
+
+    env = {"process_env_vars": {"RT_POOL_MARK": "a"}}
+    mark_a, pid_a = ray_tpu.get(
+        probe.options(runtime_env=env).remote())
+    assert mark_a == "a"
+    assert pid_a != plain_pid  # dedicated worker, not the plain pool's
+
+    # same env key reuses the pool's worker; different key gets another
+    mark_a2, pid_a2 = ray_tpu.get(
+        probe.options(runtime_env=env).remote())
+    assert (mark_a2, pid_a2) == ("a", pid_a)
+    mark_b, pid_b = ray_tpu.get(probe.options(
+        runtime_env={"process_env_vars": {"RT_POOL_MARK": "b"}}).remote())
+    assert mark_b == "b" and pid_b not in (pid_a, plain_pid)
+
+    # plain tasks never land on env-keyed workers
+    m, pid = ray_tpu.get(probe.remote())
+    assert m is None and pid not in (pid_a, pid_b)
+
+
+def test_process_env_vars_on_actor(cluster):
+    @ray_tpu.remote
+    class A:
+        def mark(self):
+            return os.environ.get("RT_POOL_MARK")
+
+    a = A.options(runtime_env={
+        "process_env_vars": {"RT_POOL_MARK": "actor"}}).remote()
+    assert ray_tpu.get(a.mark.remote()) == "actor"
+    ray_tpu.kill(a)
