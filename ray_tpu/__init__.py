@@ -252,9 +252,11 @@ def available_resources() -> Dict[str, float]:
 
 
 def timeline(limit: int = 1000) -> List[dict]:
-    """Recent task state transitions from the GCS task-event store
-    (ref: `ray timeline` scripts.py:1835)."""
-    return _rt.get_runtime().gcs_call("list_task_events", limit=limit)
+    """Recent task state transitions (and tracing spans) from the GCS
+    task-event store (ref: `ray timeline` scripts.py:1835)."""
+    rt = _rt.get_runtime()
+    rt.flush_task_events(wait=True)
+    return rt.gcs_call("list_task_events", limit=limit)
 
 
 __all__ = [

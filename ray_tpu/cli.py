@@ -91,6 +91,18 @@ def cmd_timeline(args):
     trace = []
     starts = {}
     for ev in reversed(events):
+        if ev.get("kind") == "span":
+            # tracing spans share the task-event store (util/tracing.py)
+            trace.append({
+                "name": ev["name"], "cat": "span", "ph": "X",
+                "ts": ev["ts"] * 1e6, "dur": ev.get("dur", 0) * 1e6,
+                "pid": 1, "tid": hash(ev["trace_id"]) % 64,
+                "args": {**ev.get("attrs", {}),
+                         "trace_id": ev["trace_id"],
+                         "span_id": ev["span_id"],
+                         "parent_id": ev.get("parent_id")},
+            })
+            continue
         key = ev["task_id"]
         if ev["state"] == "RUNNING":
             starts[key] = ev["ts"]

@@ -214,6 +214,9 @@ class TaskSpec:
     is_actor_call: bool = False
     method_name: Optional[str] = None
     seq_no: int = -1                    # per-caller ordering (ref: actor submit queue)
+    # tracing context {trace_id, span_id} (ref: tracing_helper.py
+    # _function_hydrate_span_args — span context rides the task spec)
+    trace_ctx: Optional[dict] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]

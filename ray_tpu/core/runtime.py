@@ -159,6 +159,8 @@ class Runtime:
         self.default_runtime_env: Optional[dict] = None  # job-level env
         self._renv_cache: Dict[str, dict] = {}
         self._task_events: List[dict] = []
+        # appended from executor threads (spans), swapped on the loop
+        self._task_events_lock = threading.Lock()
         self.address: Optional[RuntimeAddress] = None
         self._started = False
         self._shutdown = False
@@ -621,10 +623,22 @@ class Runtime:
             owner=self.address, job_id=self.job_id, max_retries=mr,
             retry_exceptions=retry_exceptions,
             scheduling=scheduling or SchedulingStrategy(),
-            runtime_env=self.resolve_runtime_env(runtime_env))
+            runtime_env=self.resolve_runtime_env(runtime_env),
+            trace_ctx=self._trace_ctx())
         refs = self._register_returns(spec, arg_ids)
         self._submit_spec(spec, retries_left=mr)
         return refs
+
+    @staticmethod
+    def _trace_ctx() -> Optional[dict]:
+        """Caller's span context, stamped on outgoing specs
+        (ref: tracing_helper.py _function_hydrate_span_args). A live
+        context propagates regardless of the local enable flag — workers
+        are never "enabled" process-locally, yet tasks they submit must
+        continue the caller's trace."""
+        from ray_tpu.util import tracing
+
+        return tracing.current_context()
 
     def _register_returns(self, spec: TaskSpec, arg_ids: List[ObjectID]) -> List[ObjectRef]:
         refs = []
@@ -932,7 +946,8 @@ class Runtime:
             is_actor_creation=True, actor_id=actor_id,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             actor_name=name, namespace=namespace,
-            runtime_env=self.resolve_runtime_env(runtime_env))
+            runtime_env=self.resolve_runtime_env(runtime_env),
+            trace_ctx=self._trace_ctx())
         self.refs.on_task_submitted(arg_ids)
         r = self.gcs_call("register_actor", spec=spec)
         if not r.get("ok"):
@@ -1016,7 +1031,8 @@ class Runtime:
             num_returns=num_returns, resources=ResourceSet({}),
             owner=self.address, job_id=self.job_id,
             is_actor_call=True, actor_id=actor_id, method_name=method_name,
-            seq_no=self._actor_seq[actor_id], max_retries=max_task_retries)
+            seq_no=self._actor_seq[actor_id], max_retries=max_task_retries,
+            trace_ctx=self._trace_ctx())
         refs = self._register_returns(spec, arg_ids)
         self._actor_queue(actor_id).append((spec, max_task_retries))
         self._spawn(self._actor_sender(actor_id))
@@ -1145,17 +1161,39 @@ class Runtime:
 
     def _record_event(self, spec: TaskSpec, state: str):
         """ref: task_event_buffer.h:199 — bounded buffer, flushed to GCS."""
-        self._task_events.append({
-            "task_id": spec.task_id.hex(), "name": spec.name, "state": state,
-            "job_id": self.job_id, "ts": time.time(),
-            "actor_id": spec.actor_id.hex() if spec.actor_id else None})
-        if len(self._task_events) >= 100:
+        with self._task_events_lock:
+            self._task_events.append({
+                "task_id": spec.task_id.hex(), "name": spec.name,
+                "state": state, "job_id": self.job_id, "ts": time.time(),
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None})
+            full = len(self._task_events) >= 100
+        if full:
             self.flush_task_events()
 
-    def flush_task_events(self):
-        evs, self._task_events = self._task_events, []
+    def record_span(self, span: dict):
+        """Tracing spans ride the task-event channel to the GCS — one
+        store serves task states and spans (ref: profile events share the
+        TaskEventBuffer, task_event_buffer.h)."""
+        with self._task_events_lock:
+            self._task_events.append(span)
+            full = len(self._task_events) >= 100
+        if full:
+            self.flush_task_events()
+
+    def flush_task_events(self, wait: bool = False):
+        """Ship buffered events; `wait=True` blocks until the GCS acked
+        (readers like `ray_tpu.timeline()` need read-your-writes)."""
+        with self._task_events_lock:
+            evs, self._task_events = self._task_events, []
         if not evs:
             return
+        if wait:
+            try:
+                self.gcs_call("add_task_events", events=evs)
+            except Exception:
+                pass
+            return
+
         async def _send():
             try:
                 await self.pool.get(self.gcs_addr).call("add_task_events",

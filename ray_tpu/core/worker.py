@@ -119,7 +119,13 @@ class Worker:
                                    if self.actor_spec else None)
         self.runtime.set_exec_context(spec.task_id, runtime_env=env)
         try:
-            with TaskEnvContext(self.runtime, spec.runtime_env):
+            from ray_tpu.util.tracing import continue_trace
+
+            span_name = (f"actor::{spec.method_name}" if spec.is_actor_call
+                         else f"task::{spec.name}")
+            with TaskEnvContext(self.runtime, spec.runtime_env), \
+                    continue_trace(spec.trace_ctx, span_name,
+                                   {"task_id": spec.task_id.hex()}):
                 if fn is None:
                     fn = self.runtime.load_function(spec.func_id)
                 args, kwargs = self._resolve_args(spec)
@@ -137,7 +143,12 @@ class Worker:
 
     async def rpc_push_task(self, spec: TaskSpec) -> TaskResult:
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(self.task_executor, self._execute, spec)
+        result = await loop.run_in_executor(self.task_executor,
+                                            self._execute, spec)
+        # worker-side task events are tracing spans — ship them promptly
+        # so `ray_tpu.timeline()` sees fresh traces
+        self.runtime.flush_task_events()
+        return result
 
     async def rpc_create_actor(self, spec: TaskSpec) -> dict:
         self.actor_spec = spec
@@ -148,6 +159,7 @@ class Worker:
 
         def _ctor():
             from ray_tpu.runtime_env import TaskEnvContext
+            from ray_tpu.util.tracing import continue_trace
 
             self.runtime.set_exec_context(spec.task_id,
                                           runtime_env=spec.runtime_env)
@@ -158,7 +170,11 @@ class Worker:
                 TaskEnvContext(self.runtime, spec.runtime_env).__enter__()
                 cls = self.runtime.load_function(spec.func_id)
                 args, kwargs = self._resolve_args(spec)
-                self.actor_instance = cls(*args, **kwargs)
+                with continue_trace(spec.trace_ctx,
+                                    f"actor::{spec.name}.__init__",
+                                    {"actor_id": spec.actor_id.hex()}):
+                    self.actor_instance = cls(*args, **kwargs)
+                self.runtime.flush_task_events()
                 return {"ok": True}
             except BaseException:
                 return {"ok": False, "error": traceback.format_exc()}
@@ -195,8 +211,10 @@ class Worker:
                 finally:
                     self.runtime.clear_exec_context()
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(self.task_executor, self._execute,
-                                          spec, method)
+        result = await loop.run_in_executor(self.task_executor, self._execute,
+                                            spec, method)
+        self.runtime.flush_task_events()
+        return result
 
     async def rpc_exit_worker(self, reason: str = "") -> dict:
         logger.info("worker exiting: %s", reason)

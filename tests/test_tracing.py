@@ -1,0 +1,117 @@
+"""Distributed tracing: span propagation through task/actor calls.
+
+Reference test model: python/ray/tests/test_tracing.py — spans created
+for remote calls, user spans nest, context propagates across processes.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    tracing.enable()
+    yield
+    tracing.disable()
+    ray_tpu.shutdown()
+
+
+def _spans():
+    return [e for e in ray_tpu.timeline(limit=2000)
+            if e.get("kind") == "span"]
+
+
+def test_local_span_nesting(cluster):
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            pass
+    assert inner["trace_id"] == outer["trace_id"]
+    assert inner["parent_id"] == outer["span_id"]
+    names = {s["name"] for s in _spans()}
+    assert {"outer", "inner"} <= names
+
+
+def test_trace_propagates_to_task(cluster):
+    @ray_tpu.remote
+    def traced_child():
+        # nested user span inside the task continues the same trace
+        with tracing.span("in_task_work"):
+            return tracing.current_context()["trace_id"]
+
+    with tracing.span("driver_root") as root:
+        child_trace = ray_tpu.get(traced_child.remote())
+    assert child_trace == root["trace_id"]
+
+    spans = _spans()
+    task_spans = [s for s in spans if s["name"] == "task::traced_child"]
+    assert task_spans, spans
+    ts = task_spans[-1]
+    assert ts["trace_id"] == root["trace_id"]
+    assert ts["parent_id"] == root["span_id"]
+    work = [s for s in spans if s["name"] == "in_task_work"][-1]
+    assert work["parent_id"] == ts["span_id"]
+    assert "task_id" in ts["attrs"]
+
+
+def test_trace_propagates_to_actor(cluster):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return tracing.current_context()["trace_id"]
+
+    with tracing.span("actor_root") as root:
+        a = A.remote()
+        t = ray_tpu.get(a.m.remote())
+    assert t == root["trace_id"]
+    spans = _spans()
+    m = [s for s in spans if s["name"] == "actor::m"]
+    assert m and m[-1]["parent_id"] == root["span_id"]
+    init = [s for s in spans if s["name"] == "actor::A.__init__"]
+    assert init and init[-1]["trace_id"] == root["trace_id"]
+    ray_tpu.kill(a)
+
+
+def test_disabled_no_spans(cluster):
+    tracing.disable()
+    try:
+        before = len(_spans())
+
+        @ray_tpu.remote
+        def f():
+            return tracing.current_context()
+
+        assert ray_tpu.get(f.remote()) is None
+        # user spans are no-ops when tracing is off
+        with tracing.span("ignored") as s:
+            assert s is None
+        assert len(_spans()) == before
+    finally:
+        tracing.enable()
+
+
+def test_grandchild_task_continues_trace(cluster):
+    """Tasks submitted FROM a worker keep the trace even though workers
+    never call enable() process-locally."""
+    @ray_tpu.remote
+    def leaf():
+        return tracing.current_context()["trace_id"]
+
+    @ray_tpu.remote
+    def mid():
+        return ray_tpu.get(leaf.remote())
+
+    with tracing.span("root") as root:
+        assert ray_tpu.get(mid.remote()) == root["trace_id"]
+    leaf_spans = [s for s in _spans() if s["name"] == "task::leaf"]
+    assert leaf_spans and leaf_spans[-1]["trace_id"] == root["trace_id"]
+
+
+def test_span_records_errors(cluster):
+    with pytest.raises(ValueError):
+        with tracing.span("failing"):
+            raise ValueError("boom")
+    s = [x for x in _spans() if x["name"] == "failing"][-1]
+    assert "ValueError" in s["attrs"]["error"]
