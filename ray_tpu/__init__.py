@@ -251,6 +251,35 @@ def available_resources() -> Dict[str, float]:
     return total
 
 
+def _fanout_nodelets(method: str) -> Dict[str, dict]:
+    """Call `method` on every alive nodelet; errors become {"error": ...}."""
+    rt = _rt.get_runtime()
+    out = {}
+    for n in rt.gcs_call("get_nodes"):
+        if not n.alive:
+            continue
+        try:
+            out[n.node_id.hex()] = rt.node_call(n.nodelet_addr, method)
+        except Exception as e:
+            out[n.node_id.hex()] = {"error": str(e)}
+    return out
+
+
+def stack() -> Dict[str, dict]:
+    """All-thread stack dumps from every worker on every alive node
+    (ref: `ray stack` scripts.py:1789)."""
+    return _fanout_nodelets("dump_worker_stacks")
+
+
+def internal_stats() -> Dict[str, dict]:
+    """Per-daemon handler counts/latency + event-loop lag
+    (ref: event_stats.h instrumentation + per-daemon OpenCensus stats)."""
+    out = {"gcs": _rt.get_runtime().gcs_call("internal_stats")}
+    for nid, stats in _fanout_nodelets("internal_stats").items():
+        out[f"nodelet:{nid[:12]}"] = stats
+    return out
+
+
 def timeline(limit: int = 1000) -> List[dict]:
     """Recent task state transitions (and tracing spans) from the GCS
     task-event store (ref: `ray timeline` scripts.py:1835)."""
@@ -262,6 +291,7 @@ def timeline(limit: int = 1000) -> List[dict]:
 __all__ = [
     "init", "shutdown", "remote", "put", "get", "wait", "kill", "cancel",
     "method", "get_actor", "nodes", "cluster_resources", "available_resources",
-    "timeline", "ObjectRef", "ActorHandle", "exceptions", "is_initialized",
+    "timeline", "stack", "internal_stats",
+    "ObjectRef", "ActorHandle", "exceptions", "is_initialized",
     "__version__",
 ]

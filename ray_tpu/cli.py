@@ -84,6 +84,26 @@ def cmd_summary(args):
     print(json.dumps(state.summarize_tasks(), indent=2, default=str))
 
 
+def cmd_stack(args):
+    """Stack dumps of every worker on every node (ref: ray stack)."""
+    ray_tpu = _connect(args.address)
+    for node_id, dump in ray_tpu.stack().items():
+        print(f"===== node {node_id[:12]} =====")
+        if "error" in dump:
+            print(f"ERROR: {dump['error']}")
+            continue
+        for wid, w in dump.get("workers", {}).items():
+            print(f"--- worker {wid} pid={w.get('pid')} "
+                  f"state={w.get('state')} ---")
+            print(w.get("stacks", w.get("error", "")))
+
+
+def cmd_istats(args):
+    """Per-daemon handler stats + event-loop lag (ref: event_stats)."""
+    ray_tpu = _connect(args.address)
+    print(json.dumps(ray_tpu.internal_stats(), indent=2, default=str))
+
+
 def cmd_timeline(args):
     """Chrome-trace export of task events (ref: ray timeline)."""
     ray_tpu = _connect(args.address)
@@ -190,7 +210,8 @@ def main():
     s.set_defaults(fn=cmd_stop)
 
     for name, fn in [("status", cmd_status), ("summary", cmd_summary),
-                     ("memory", cmd_memory), ("metrics", cmd_metrics)]:
+                     ("memory", cmd_memory), ("metrics", cmd_metrics),
+                     ("stack", cmd_stack), ("internal-stats", cmd_istats)]:
         s = sub.add_parser(name)
         s.add_argument("--address", required=True)
         s.set_defaults(fn=fn)

@@ -348,6 +348,27 @@ class Nodelet:
         w.ready.set()
         return {"ok": True}
 
+    async def rpc_dump_worker_stacks(self) -> dict:
+        """Fan a stack-dump request to every live worker on this node,
+        concurrently — hung workers (the thing `ray stack` debugs) must
+        cost one timeout total, not one each."""
+        live = [w for w in self.workers.values()
+                if w.addr is not None and w.state != "dead"]
+
+        async def dump(w):
+            try:
+                r = await self.pool.get(tuple(w.addr)).call(
+                    "dump_stacks", timeout=5.0)
+                r["state"] = w.state
+                return r
+            except Exception as e:
+                return {"error": str(e), "state": w.state}
+
+        results = await asyncio.gather(*(dump(w) for w in live))
+        return {"node_id": self.node_id.hex(),
+                "workers": {w.worker_id.hex()[:12]: r
+                            for w, r in zip(live, results)}}
+
     async def rpc_kill_worker(self, worker_id: bytes, reason: str = "") -> dict:
         w = self.workers.get(worker_id)
         if w is not None:

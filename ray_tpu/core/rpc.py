@@ -19,6 +19,7 @@ import itertools
 import pickle
 import struct
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -60,17 +61,59 @@ class RpcServer:
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
+        # Per-method handler stats (ref: src/ray/common/event_stats.h —
+        # every asio handler is timed; surfaced via `internal_stats`).
+        self._stats: Dict[str, Dict[str, float]] = {}
+        self._started_at = time.time()
+        self._loop_lag_s = 0.0
+        self._loop_lag_max_s = 0.0
+        self._lag_task: Optional[asyncio.Task] = None
 
     async def start(self) -> Tuple[str, int]:
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self._lag_task = asyncio.get_running_loop().create_task(
+            self._measure_loop_lag())
         return self.host, self.port
+
+    async def _measure_loop_lag(self):
+        """Event-loop responsiveness probe: how late a 100ms sleep wakes
+        up (ref: event-loop lag surfaced by RAY_CONFIG(event_stats ...)).
+        Tracks the max as well — a one-cycle spike would otherwise be
+        overwritten before anyone reads it."""
+        while True:
+            t0 = time.monotonic()
+            try:
+                await asyncio.sleep(0.1)
+            except asyncio.CancelledError:
+                return
+            lag = max(time.monotonic() - t0 - 0.1, 0.0)
+            self._loop_lag_s = lag
+            if lag > self._loop_lag_max_s:
+                self._loop_lag_max_s = lag
+
+    def _stat(self, method: str) -> Dict[str, float]:
+        return self._stats.setdefault(
+            method, {"count": 0, "errors": 0, "total_s": 0.0, "max_s": 0.0})
+
+    def internal_stats(self) -> dict:
+        """Per-method handler counts/latency + loop lag, for every daemon
+        (ref: per-daemon OpenCensus stats, src/ray/stats/metric_defs.h)."""
+        return {
+            "uptime_s": time.time() - self._started_at,
+            "event_loop_lag_s": self._loop_lag_s,
+            "event_loop_lag_max_s": self._loop_lag_max_s,
+            "handlers": {m: dict(s) for m, s in self._stats.items()},
+        }
 
     @property
     def address(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
     async def stop(self):
+        if self._lag_task is not None:
+            self._lag_task.cancel()
+            self._lag_task = None
         if self._server:
             self._server.close()
             try:
@@ -94,17 +137,32 @@ class RpcServer:
                 pass
 
     async def _dispatch(self, writer, kind, msg_id, method, payload):
+        t0 = time.monotonic()
+        known = True
         try:
-            fn = getattr(self.handler, f"rpc_{method}", None)
-            if fn is None:
-                raise RpcError(f"no such method: {method}")
-            res = fn(**payload)
-            if asyncio.iscoroutine(res):
-                res = await res
+            if method == "internal_stats":
+                res = self.internal_stats()
+            else:
+                fn = getattr(self.handler, f"rpc_{method}", None)
+                if fn is None:
+                    # don't let client-supplied garbage names grow _stats
+                    known = False
+                    raise RpcError(f"no such method: {method}")
+                res = fn(**payload)
+                if asyncio.iscoroutine(res):
+                    res = await res
+            el = time.monotonic() - t0
+            s = self._stat(method)
+            s["count"] += 1
+            s["total_s"] += el
+            if el > s["max_s"]:
+                s["max_s"] = el
             if kind == REQUEST:
                 writer.write(_frame((RESPONSE_OK, msg_id, method, res)))
                 await writer.drain()
         except Exception:
+            if known:
+                self._stat(method)["errors"] += 1
             if kind == REQUEST:
                 try:
                     writer.write(_frame(

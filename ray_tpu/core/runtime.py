@@ -221,6 +221,13 @@ class Runtime:
 
     # ------------------------------------------------------------ gcs helpers
 
+    def node_call(self, addr, method: str,
+                  rpc_timeout: Optional[float] = 30.0, **kw):
+        """Synchronous RPC to an arbitrary daemon (nodelet/worker) —
+        observability fan-outs (`ray_tpu.stack()`, internal stats)."""
+        return self._run(self.pool.get(tuple(addr)).call(
+            method, timeout=rpc_timeout, **kw))
+
     def gcs_call(self, method: str, rpc_timeout: Optional[float] = 60.0, **kw):
         """kw may itself contain a `timeout` destined for the handler;
         `rpc_timeout` is the transport deadline.

@@ -216,6 +216,18 @@ class Worker:
         self.runtime.flush_task_events()
         return result
 
+    async def rpc_dump_stacks(self) -> dict:
+        """All-thread stack dump (ref: `ray stack` scripts.py:1789 —
+        py-spy over workers; here the worker self-reports, no ptrace)."""
+        import threading
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = []
+        for tid, frame in sys._current_frames().items():
+            parts.append(f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
+                         + "".join(traceback.format_stack(frame)))
+        return {"pid": os.getpid(), "stacks": "\n".join(parts)}
+
     async def rpc_exit_worker(self, reason: str = "") -> dict:
         logger.info("worker exiting: %s", reason)
         asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))

@@ -92,3 +92,50 @@ def test_autoscaler_scales_up_and_down(ray_start_cluster):
         time.sleep(1.0)
         terminated += scaler.update()["terminated"]
     assert terminated, "autoscaler did not scale down the idle node"
+
+
+def test_stack_and_internal_stats(ray_start_regular):
+    """ref: `ray stack` (scripts.py:1789) and event_stats.h handler
+    instrumentation surfaced per daemon."""
+    import time
+
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self, s):
+            time.sleep(s)
+            return "done"
+
+    s = Sleeper.remote()
+    ref = s.nap.remote(6.0)
+    # poll until the nap shows up in some worker stack (first worker
+    # spawn includes the ~5s jax import, so a fixed sleep races it)
+    deadline = time.time() + 30
+    all_stacks = ""
+    while time.time() < deadline and "nap" not in all_stacks:
+        dumps = ray_tpu.stack()
+        assert dumps
+        all_stacks = "\n".join(
+            w.get("stacks", "")
+            for node in dumps.values()
+            for w in node.get("workers", {}).values())
+        time.sleep(0.3)
+    # the sleeping actor method must be visible in some worker stack
+    assert "nap" in all_stacks
+
+    ray_tpu.internal_stats()          # prime: a call can't count itself
+    stats = ray_tpu.internal_stats()
+    assert "gcs" in stats
+    gcs = stats["gcs"]
+    assert gcs["uptime_s"] > 0
+    assert gcs["event_loop_lag_s"] < 5.0
+    # the GCS has served heartbeats and the priming internal_stats call
+    assert "internal_stats" in gcs["handlers"]
+    assert any(h["count"] > 0 for h in gcs["handlers"].values())
+    nodelets = [v for k, v in stats.items() if k.startswith("nodelet:")]
+    assert nodelets and all("handlers" in n for n in nodelets)
+    # per-method latency accounting is sane
+    for h in gcs["handlers"].values():
+        assert h["total_s"] >= 0 and h["max_s"] >= 0 and h["errors"] >= 0
+
+    assert ray_tpu.get(ref) == "done"
+    ray_tpu.kill(s)
