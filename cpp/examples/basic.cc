@@ -1,0 +1,44 @@
+// Basic ray_tpu C++ client walkthrough (ref: the reference's
+// cpp/example/example.cc). Run a gateway first:
+//   python -m ray_tpu.client_gateway --address <gcs host:port> --port 10001
+// Build:
+//   g++ -std=c++17 -Icpp/include cpp/examples/basic.cc cpp/src/client.cc \
+//       -o basic && ./basic 127.0.0.1 10001
+#include <cstdio>
+#include <cstdlib>
+
+#include "raytpu/client.h"
+
+using raytpu::Json;
+using raytpu::JsonArray;
+using raytpu::JsonObject;
+
+int main(int argc, char** argv) {
+  const char* host = argc > 1 ? argv[1] : "127.0.0.1";
+  int port = argc > 2 ? atoi(argv[2]) : 10001;
+  raytpu::Client c(host, port);
+
+  // objects
+  auto ref = c.Put(Json(JsonObject{{"x", Json(41)}}));
+  Json back = c.Get(ref);
+  printf("put/get x=%lld\n", (long long)back["x"].as_int());
+
+  // tasks: named python functions run on cluster workers;
+  // object refs flow as arguments
+  auto h = c.Task("math:hypot", {Json(3), Json(4)});
+  printf("math:hypot(3,4) = %g\n", c.Get(h).as_number());
+
+  auto chained = c.Task("math:floor", {h.AsArg()});
+  printf("math:floor(ref) = %lld\n", (long long)c.Get(chained).as_int());
+
+  // actors: stateful named python classes
+  auto counter = c.Actor("collections:Counter");
+  c.Get(c.Call(counter, "update", {Json(JsonObject{{"tpu", Json(3)}})}));
+  Json top = c.Get(c.Call(counter, "most_common"));
+  printf("counter: %s\n", top.dump().c_str());
+  c.Kill(counter);
+
+  printf("cluster: %s\n", Json(c.ClusterResources()).dump().c_str());
+  printf("OK\n");
+  return 0;
+}

@@ -1,0 +1,109 @@
+// ray_tpu C++ client API.
+//
+// Reference: the C++ worker API (cpp/include/ray/api.h — ray::Init,
+// ray::Task(...).Remote(), ray::Get, ray::Actor). Re-designed for the
+// TPU framework's gateway protocol: the client is a thin remote driver
+// speaking length-prefixed JSON frames to a ClientGateway
+// (ray_tpu/client_gateway.py); objects/actors live in the gateway's
+// driver, functions are named python entry points ("module:function")
+// resolved on the executing worker.
+//
+//   raytpu::Client c("127.0.0.1", 10001);
+//   auto ref = c.Put(raytpu::Json(41));
+//   auto out = c.Get(c.Task("mymod:add_one", {ref.AsArg()}));
+//
+// Build: g++ -std=c++17 -Icpp/include your.cc cpp/src/client.cc
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "raytpu/json.h"
+
+namespace raytpu {
+
+class Client;
+
+// A handle to an object owned by the gateway driver.
+class ObjectRef {
+ public:
+  ObjectRef() = default;
+  ObjectRef(std::string hex) : hex_(std::move(hex)) {}
+  const std::string& hex() const { return hex_; }
+  // The wire form usable as a task argument.
+  Json AsArg() const { return Json(JsonObject{{"__ref__", Json(hex_)}}); }
+
+ private:
+  std::string hex_;
+};
+
+class ActorHandle {
+ public:
+  ActorHandle() = default;
+  ActorHandle(std::string hex) : hex_(std::move(hex)) {}
+  const std::string& hex() const { return hex_; }
+
+ private:
+  std::string hex_;
+};
+
+struct TaskOptions {
+  int num_returns = 1;
+  double num_cpus = -1;       // <0 = default
+  JsonObject resources;       // e.g. {"TPU": Json(1)}
+  int max_retries = -1;       // <0 = default
+};
+
+class Client {
+ public:
+  // Connects and pings the gateway; throws std::runtime_error on failure.
+  Client(const std::string& host, int port);
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Store a JSON value in the cluster object store.
+  ObjectRef Put(const Json& value);
+
+  // Fetch one object (throws on task error or timeout).
+  Json Get(const ObjectRef& ref, double timeout_s = 60.0);
+  std::vector<Json> Get(const std::vector<ObjectRef>& refs,
+                        double timeout_s = 60.0);
+
+  // Submit a named python function ("module:function") as a cluster
+  // task. Args are JSON values; use ObjectRef::AsArg() to pass refs.
+  // Task() requires opts.num_returns == 1 (throws otherwise);
+  // TaskN() returns every return ref.
+  ObjectRef Task(const std::string& func, const JsonArray& args = {},
+                 const TaskOptions& opts = {});
+  std::vector<ObjectRef> TaskN(const std::string& func,
+                               const JsonArray& args = {},
+                               const TaskOptions& opts = {});
+
+  // Wait for up to num_returns refs to become ready.
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Wait(
+      const std::vector<ObjectRef>& refs, int num_returns = 1,
+      double timeout_s = -1);
+
+  // Actors: create a named python class, call its methods.
+  ActorHandle Actor(const std::string& cls, const JsonArray& args = {},
+                    const TaskOptions& opts = {});
+  ObjectRef Call(const ActorHandle& actor, const std::string& method,
+                 const JsonArray& args = {});
+  ActorHandle GetActor(const std::string& name,
+                       const std::string& ns = "default");
+  void Kill(const ActorHandle& actor);
+
+  // Drop gateway-held references so the cluster can reclaim objects.
+  void Release(const std::vector<ObjectRef>& refs);
+
+  JsonObject ClusterResources();
+
+ private:
+  Json Invoke(const std::string& method, const JsonObject& params);
+
+  int fd_ = -1;
+  int64_t next_id_ = 0;
+};
+
+}  // namespace raytpu

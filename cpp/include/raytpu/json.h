@@ -1,0 +1,272 @@
+// Minimal JSON value + parser/serializer for the ray_tpu C++ client.
+// (ref: the reference C++ worker API cpp/include/ray/api.h serializes
+// via msgpack; here the gateway protocol is JSON so the client carries
+// a small self-contained implementation, no third-party deps.)
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace raytpu {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(int64_t v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+
+  bool as_bool() const { check(Type::Bool); return bool_; }
+  double as_number() const { check(Type::Number); return num_; }
+  int64_t as_int() const { check(Type::Number);
+    return static_cast<int64_t>(num_); }
+  const std::string& as_string() const { check(Type::String); return str_; }
+  const JsonArray& as_array() const { check(Type::Array); return arr_; }
+  const JsonObject& as_object() const { check(Type::Object); return obj_; }
+  JsonArray& as_array() { check(Type::Array); return arr_; }
+  JsonObject& as_object() { check(Type::Object); return obj_; }
+
+  const Json& operator[](const std::string& k) const {
+    check(Type::Object);
+    auto it = obj_.find(k);
+    if (it == obj_.end()) throw std::runtime_error("no key: " + k);
+    return it->second;
+  }
+  bool contains(const std::string& k) const {
+    return type_ == Type::Object && obj_.count(k) > 0;
+  }
+
+  std::string dump() const {
+    std::ostringstream out;
+    write(out);
+    return out.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+ private:
+  void check(Type t) const {
+    if (type_ != t) throw std::runtime_error("json type mismatch");
+  }
+
+  void write(std::ostringstream& out) const {
+    switch (type_) {
+      case Type::Null: out << "null"; break;
+      case Type::Bool: out << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        // range check BEFORE the cast — converting an out-of-range
+        // double to int64 is undefined behavior
+        if (num_ >= -1e15 && num_ <= 1e15 &&
+            num_ == static_cast<int64_t>(num_)) {
+          out << static_cast<int64_t>(num_);
+        } else {
+          out.precision(17);
+          out << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(out, str_); break;
+      case Type::Array: {
+        out << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) out << ',';
+          arr_[i].write(out);
+        }
+        out << ']';
+        break;
+      }
+      case Type::Object: {
+        out << '{';
+        bool first = true;
+        for (const auto& kv : obj_) {
+          if (!first) out << ',';
+          first = false;
+          write_string(out, kv.first);
+          out << ':';
+          kv.second.write(out);
+        }
+        out << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& out, const std::string& s) {
+    out << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out << "\\\""; break;
+        case '\\': out << "\\\\"; break;
+        case '\n': out << "\\n"; break;
+        case '\r': out << "\\r"; break;
+        case '\t': out << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out << buf;
+          } else {
+            out << c;
+          }
+      }
+    }
+    out << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& p) {
+    while (p < t.size() && (t[p] == ' ' || t[p] == '\t' || t[p] == '\n' ||
+                            t[p] == '\r'))
+      ++p;
+  }
+
+  static Json parse_value(const std::string& t, size_t& p) {
+    skip_ws(t, p);
+    if (p >= t.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = t[p];
+    if (c == '{') return parse_object(t, p);
+    if (c == '[') return parse_array(t, p);
+    if (c == '"') return Json(parse_string(t, p));
+    if (t.compare(p, 4, "true") == 0) { p += 4; return Json(true); }
+    if (t.compare(p, 5, "false") == 0) { p += 5; return Json(false); }
+    if (t.compare(p, 4, "null") == 0) { p += 4; return Json(); }
+    return parse_number(t, p);
+  }
+
+  static Json parse_object(const std::string& t, size_t& p) {
+    JsonObject obj;
+    ++p;  // '{'
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == '}') { ++p; return Json(std::move(obj)); }
+    while (true) {
+      skip_ws(t, p);
+      std::string key = parse_string(t, p);
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != ':')
+        throw std::runtime_error("expected ':'");
+      ++p;
+      obj.emplace(std::move(key), parse_value(t, p));
+      skip_ws(t, p);
+      if (p < t.size() && t[p] == ',') { ++p; continue; }
+      if (p < t.size() && t[p] == '}') { ++p; return Json(std::move(obj)); }
+      throw std::runtime_error("expected ',' or '}'");
+    }
+  }
+
+  static Json parse_array(const std::string& t, size_t& p) {
+    JsonArray arr;
+    ++p;  // '['
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == ']') { ++p; return Json(std::move(arr)); }
+    while (true) {
+      arr.push_back(parse_value(t, p));
+      skip_ws(t, p);
+      if (p < t.size() && t[p] == ',') { ++p; continue; }
+      if (p < t.size() && t[p] == ']') { ++p; return Json(std::move(arr)); }
+      throw std::runtime_error("expected ',' or ']'");
+    }
+  }
+
+  static std::string parse_string(const std::string& t, size_t& p) {
+    if (p >= t.size() || t[p] != '"')
+      throw std::runtime_error("expected string");
+    ++p;
+    std::string out;
+    while (p < t.size()) {
+      char c = t[p++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (p >= t.size()) break;
+        char e = t[p++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (p + 4 > t.size()) throw std::runtime_error("bad \\u escape");
+            unsigned code = std::stoul(t.substr(p, 4), nullptr, 16);
+            p += 4;
+            // UTF-8 encode (surrogate pairs for the BMP-adjacent planes)
+            if (code >= 0xD800 && code <= 0xDBFF && p + 6 <= t.size() &&
+                t[p] == '\\' && t[p + 1] == 'u') {
+              unsigned lo = std::stoul(t.substr(p + 2, 4), nullptr, 16);
+              p += 6;
+              code = 0x10000 + ((code - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else if (code < 0x10000) {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (code >> 18));
+              out += static_cast<char>(0x80 | ((code >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    throw std::runtime_error("unterminated string");
+  }
+
+  static Json parse_number(const std::string& t, size_t& p) {
+    size_t start = p;
+    if (p < t.size() && (t[p] == '-' || t[p] == '+')) ++p;
+    while (p < t.size() && (isdigit(t[p]) || t[p] == '.' || t[p] == 'e' ||
+                            t[p] == 'E' || t[p] == '-' || t[p] == '+'))
+      ++p;
+    if (p == start) throw std::runtime_error("bad JSON value");
+    return Json(std::stod(t.substr(start, p - start)));
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace raytpu

@@ -1,0 +1,185 @@
+// ray_tpu C++ client implementation (see include/raytpu/client.h).
+// POSIX sockets only — the client targets TPU-VM-class Linux hosts.
+
+#include "raytpu/client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+
+namespace raytpu {
+
+namespace {
+
+void WriteAll(int fd, const char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = ::write(fd, data + off, n - off);
+    if (w <= 0) throw std::runtime_error("gateway connection write failed");
+    off += static_cast<size_t>(w);
+  }
+}
+
+void ReadAll(int fd, char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = ::read(fd, data + off, n - off);
+    if (r <= 0) throw std::runtime_error("gateway connection closed");
+    off += static_cast<size_t>(r);
+  }
+}
+
+}  // namespace
+
+Client::Client(const std::string& host, int port) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res) {
+    throw std::runtime_error("cannot resolve gateway host " + host);
+  }
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd_ = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd_ < 0) continue;
+    if (::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd_);
+    fd_ = -1;
+  }
+  freeaddrinfo(res);
+  if (fd_ < 0) {
+    throw std::runtime_error("cannot connect to gateway " + host + ":" +
+                             port_s);
+  }
+  Invoke("ping", {});
+}
+
+Client::~Client() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+Json Client::Invoke(const std::string& method, const JsonObject& params) {
+  JsonObject req{{"id", Json(++next_id_)},
+                 {"method", Json(method)},
+                 {"params", Json(params)}};
+  std::string body = Json(req).dump();
+  uint32_t n = static_cast<uint32_t>(body.size());
+  char hdr[4];
+  memcpy(hdr, &n, 4);  // little-endian on all supported targets
+  WriteAll(fd_, hdr, 4);
+  WriteAll(fd_, body.data(), body.size());
+
+  ReadAll(fd_, hdr, 4);
+  memcpy(&n, hdr, 4);
+  std::string resp(n, '\0');
+  ReadAll(fd_, resp.data(), n);
+  Json out = Json::parse(resp);
+  if (!out["ok"].as_bool()) {
+    throw std::runtime_error("gateway error: " + out["error"].as_string());
+  }
+  return out["result"];
+}
+
+ObjectRef Client::Put(const Json& value) {
+  Json r = Invoke("put", {{"value", value}});
+  return ObjectRef(r["ref"].as_string());
+}
+
+std::vector<Json> Client::Get(const std::vector<ObjectRef>& refs,
+                              double timeout_s) {
+  JsonArray hexes;
+  for (const auto& r : refs) hexes.push_back(Json(r.hex()));
+  Json r = Invoke("get", {{"refs", Json(hexes)}, {"timeout", Json(timeout_s)}});
+  return r["values"].as_array();
+}
+
+Json Client::Get(const ObjectRef& ref, double timeout_s) {
+  return Get(std::vector<ObjectRef>{ref}, timeout_s)[0];
+}
+
+std::vector<ObjectRef> Client::TaskN(const std::string& func,
+                                     const JsonArray& args,
+                                     const TaskOptions& opts) {
+  JsonObject o;
+  if (opts.num_returns != 1) o["num_returns"] = Json(opts.num_returns);
+  if (opts.num_cpus >= 0) o["num_cpus"] = Json(opts.num_cpus);
+  if (!opts.resources.empty()) o["resources"] = Json(opts.resources);
+  if (opts.max_retries >= 0) o["max_retries"] = Json(opts.max_retries);
+  Json r = Invoke("task", {{"func", Json(func)},
+                           {"args", Json(args)},
+                           {"opts", Json(o)}});
+  std::vector<ObjectRef> out;
+  for (const auto& h : r["refs"].as_array())
+    out.push_back(ObjectRef(h.as_string()));
+  return out;
+}
+
+ObjectRef Client::Task(const std::string& func, const JsonArray& args,
+                       const TaskOptions& opts) {
+  if (opts.num_returns != 1) {
+    throw std::runtime_error("Task() is single-return; use TaskN()");
+  }
+  return TaskN(func, args, opts)[0];
+}
+
+std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Client::Wait(
+    const std::vector<ObjectRef>& refs, int num_returns, double timeout_s) {
+  JsonArray hexes;
+  for (const auto& r : refs) hexes.push_back(Json(r.hex()));
+  JsonObject params{{"refs", Json(hexes)}, {"num_returns", Json(num_returns)}};
+  if (timeout_s >= 0) params["timeout"] = Json(timeout_s);
+  Json r = Invoke("wait", params);
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> out;
+  for (const auto& h : r["ready"].as_array())
+    out.first.push_back(ObjectRef(h.as_string()));
+  for (const auto& h : r["pending"].as_array())
+    out.second.push_back(ObjectRef(h.as_string()));
+  return out;
+}
+
+ActorHandle Client::Actor(const std::string& cls, const JsonArray& args,
+                          const TaskOptions& opts) {
+  JsonObject o;
+  if (opts.num_cpus >= 0) o["num_cpus"] = Json(opts.num_cpus);
+  if (!opts.resources.empty()) o["resources"] = Json(opts.resources);
+  Json r = Invoke("actor_create", {{"cls", Json(cls)},
+                                   {"args", Json(args)},
+                                   {"opts", Json(o)}});
+  return ActorHandle(r["actor"].as_string());
+}
+
+ObjectRef Client::Call(const ActorHandle& actor, const std::string& method,
+                       const JsonArray& args) {
+  Json r = Invoke("actor_call", {{"actor", Json(actor.hex())},
+                                 {"method", Json(method)},
+                                 {"args", Json(args)}});
+  return ObjectRef(r["refs"].as_array()[0].as_string());
+}
+
+ActorHandle Client::GetActor(const std::string& name, const std::string& ns) {
+  Json r = Invoke("get_actor", {{"name", Json(name)}, {"namespace", Json(ns)}});
+  return ActorHandle(r["actor"].as_string());
+}
+
+void Client::Kill(const ActorHandle& actor) {
+  Invoke("kill", {{"actor", Json(actor.hex())}});
+}
+
+void Client::Release(const std::vector<ObjectRef>& refs) {
+  JsonArray hexes;
+  for (const auto& r : refs) hexes.push_back(Json(r.hex()));
+  Invoke("release", {{"refs", Json(hexes)}});
+}
+
+JsonObject Client::ClusterResources() {
+  return Invoke("cluster_resources", {}).as_object();
+}
+
+}  // namespace raytpu

@@ -104,6 +104,15 @@ def cmd_istats(args):
     print(json.dumps(ray_tpu.internal_stats(), indent=2, default=str))
 
 
+def cmd_gateway(args):
+    """Serve remote drivers (ref: ray client server / proxier)."""
+    import asyncio
+
+    from ray_tpu.client_gateway import serve
+
+    asyncio.run(serve(args.address, args.host, args.port))
+
+
 def cmd_timeline(args):
     """Chrome-trace export of task events (ref: ray timeline)."""
     ray_tpu = _connect(args.address)
@@ -226,6 +235,13 @@ def main():
     s.add_argument("--limit", type=int, default=10000)
     s.add_argument("--output", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("gateway", help="run a client gateway "
+                       "(remote drivers: python thin client + C++ API)")
+    s.add_argument("--address", required=True, help="GCS host:port")
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=10001)
+    s.set_defaults(fn=cmd_gateway)
 
     s = sub.add_parser("dashboard", help="run the HTTP dashboard")
     s.add_argument("--address", required=True, help="GCS host:port")
