@@ -15,6 +15,8 @@ the policy update is one jitted SPMD step on the TPU mesh.
 """
 
 from ray_tpu.rl.a2c import A2CConfig, A2CTrainer
+from ray_tpu.rl.connectors import (ClipObs, Connector, ConnectorPipeline,
+                                   FlattenObs, FrameStack, NormalizeObs)
 from ray_tpu.rl.core import Algorithm, ReplayActor, ReplayBuffer
 from ray_tpu.rl.dqn import DQNConfig, DQNTrainer
 from ray_tpu.rl.impala import ImpalaConfig, ImpalaTrainer
@@ -58,4 +60,6 @@ __all__ = [
     "MultiAgentEnv", "MultiAgentPPOConfig", "MultiAgentPPOTrainer",
     "register_multi_agent_env",
     "Learner", "LearnerGroup", "LearnerSpec",
+    "Connector", "ConnectorPipeline", "NormalizeObs", "FrameStack",
+    "FlattenObs", "ClipObs",
 ]

@@ -62,28 +62,58 @@ def categorical_sample(logits_row: np.ndarray, rng):
 @ray_tpu.remote
 class RolloutWorker(EnvSampler):
     """Samples env steps with the latest policy weights
-    (ref: rollout_worker.py; sampler.py)."""
+    (ref: rollout_worker.py; sampler.py). Observations pass through the
+    configured connector pipeline (ref: rllib agent connectors) exactly
+    once each; the policy sees and trains on connected obs."""
 
-    def sample(self, params_host, num_steps: int) -> Dict[str, np.ndarray]:
+    def __init__(self, env_name: str, seed: int = 0,
+                 env_config=None, connectors=None):
+        from ray_tpu.rl.connectors import build_pipeline
+
+        super().__init__(env_name, seed, env_config)
+        self.pipeline = build_pipeline(connectors)
+        self.pipeline.on_episode_start()
+        self._obs_t = None  # connected view of self.obs
+
+    def connector_state(self):
+        return self.pipeline.get_state()
+
+    def set_connector_state(self, state):
+        self.pipeline.set_state(state)
+
+    def sample(self, params_host, num_steps: int,
+               connector_state=None) -> Dict[str, np.ndarray]:
         import jax.numpy as jnp
 
+        # merged absolute connector state rides along with the weights
+        # (no extra sync round-trips); the returned batch carries this
+        # fragment's DELTA state for the trainer to merge
+        if connector_state is not None:
+            self.pipeline.set_state(connector_state)
         rng = np.random.default_rng(self.seed + len(self.completed))
         obs_buf, act_buf, rew_buf, done_buf, logp_buf, val_buf = \
             [], [], [], [], [], []
+        if self._obs_t is None:
+            self._obs_t = self.pipeline(np.asarray(self.obs, np.float32))
         for _ in range(num_steps):
+            obs_t = self._obs_t
             logits, value = policy_forward(params_host,
-                                           jnp.asarray(self.obs)[None])
+                                           jnp.asarray(obs_t)[None])
             action, logp = categorical_sample(np.asarray(logits)[0], rng)
-            prev, rew, term, trunc, _nobs = self.step_env(action)
-            obs_buf.append(np.asarray(prev, np.float32))
+            _prev, rew, term, trunc, _nobs = self.step_env(action)
+            if term or trunc:
+                self.pipeline.on_episode_start()
+            self._obs_t = self.pipeline(np.asarray(self.obs, np.float32))
+            obs_buf.append(np.asarray(obs_t, np.float32))
             act_buf.append(action)
             rew_buf.append(rew)
             done_buf.append(term or trunc)
             logp_buf.append(logp)
             val_buf.append(float(np.asarray(value)[0]))
-        # bootstrap value for the final state
-        _, last_v = policy_forward(params_host, jnp.asarray(self.obs)[None])
-        return {
+        # bootstrap value for the final (connected) state
+        _, last_v = policy_forward(params_host,
+                                   jnp.asarray(self._obs_t)[None])
+        out = {
             "obs": np.stack(obs_buf),
             "actions": np.asarray(act_buf, np.int32),
             "rewards": np.asarray(rew_buf, np.float32),
@@ -92,6 +122,9 @@ class RolloutWorker(EnvSampler):
             "values": np.asarray(val_buf, np.float32),
             "last_value": float(np.asarray(last_v)[0]),
         }
+        if self.pipeline.connectors:
+            out["connector_state"] = self.pipeline.get_state()
+        return out
 
 
 # --- GAE + learner -----------------------------------------------------------
@@ -163,6 +196,9 @@ class PPOConfig:
     entropy_coeff: float = 0.01
     hidden: int = 64
     seed: int = 0
+    # connector FACTORIES (zero-arg callables) so every worker gets its
+    # own stateful instances (ref: rllib connectors_v2 config)
+    obs_connectors: Optional[List[Any]] = None
 
 
 class PPOTrainer:
@@ -174,11 +210,17 @@ class PPOTrainer:
         import jax
         import optax
 
+        from ray_tpu.rl.connectors import build_pipeline
+
         self.cfg = config
         probe = gym.make(config.env, **config.env_config)
-        obs_dim = int(np.prod(probe.observation_space.shape))
+        obs0, _ = probe.reset(seed=config.seed)
         n_actions = int(probe.action_space.n)
         probe.close()
+        # obs dim AFTER the connector pipeline (e.g. FrameStack widens it)
+        self.pipeline = build_pipeline(config.obs_connectors)
+        obs_dim = int(np.prod(
+            self.pipeline(np.asarray(obs0, np.float32)).shape))
 
         self.params = init_policy(jax.random.PRNGKey(config.seed), obs_dim,
                                   n_actions, config.hidden)
@@ -187,10 +229,12 @@ class PPOTrainer:
         self.workers = [
             RolloutWorker.options(num_cpus=0.5).remote(
                 config.env, seed=config.seed + i * 1000,
-                env_config=config.env_config)
+                env_config=config.env_config,
+                connectors=config.obs_connectors)
             for i in range(config.num_rollout_workers)]
         self._update = jax.jit(self._make_update())
         self.iteration = 0
+        self._conn_abs = None  # authoritative merged connector state
 
     def _make_update(self):
         return make_ppo_update(self.cfg, self.opt)
@@ -200,9 +244,19 @@ class PPOTrainer:
 
         t0 = time.time()
         params_host = jax.device_get(self.params)
-        refs = [w.sample.remote(params_host, self.cfg.rollout_fragment_length)
+        refs = [w.sample.remote(params_host, self.cfg.rollout_fragment_length,
+                                self._conn_abs)
                 for w in self.workers]
         batches = ray_tpu.get(refs)
+
+        # connector state sync (ref: rllib MeanStdFilter collect/merge/
+        # broadcast): worker DELTAS arrive inside the sample batches,
+        # merge into the authoritative absolute state here, and the next
+        # sample() call carries it back — zero extra round-trips
+        if self.cfg.obs_connectors:
+            deltas = [b.pop("connector_state", None) for b in batches]
+            self._conn_abs = self.pipeline.merge_pipeline_states(
+                deltas, prev=self._conn_abs)
 
         obs, acts, logps, advs, rets = [], [], [], [], []
         for b in batches:
