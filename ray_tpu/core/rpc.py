@@ -183,6 +183,9 @@ class RpcClient:
         self._ids = itertools.count()
         self._conn_lock: Optional[asyncio.Lock] = None
         self._read_task: Optional[asyncio.Task] = None
+        # bumps on every (re)connect — lets callers notice a silent
+        # server restart (e.g. to re-register pubsub subscriptions)
+        self.generation = 0
 
     async def _ensure(self):
         if self._conn_lock is None:
@@ -191,6 +194,7 @@ class RpcClient:
             if self._writer is not None and not self._writer.is_closing():
                 return
             self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+            self.generation += 1
             self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
 
     async def _read_loop(self):

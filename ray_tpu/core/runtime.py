@@ -161,6 +161,8 @@ class Runtime:
         self._task_events: List[dict] = []
         # appended from executor threads (spans), swapped on the loop
         self._task_events_lock = threading.Lock()
+        self._gcs_subs: Set[str] = set()  # channels to restore on failover
+        self._gcs_sub_gen: Optional[int] = None  # conn generation at last sub
         self.address: Optional[RuntimeAddress] = None
         self._started = False
         self._shutdown = False
@@ -235,10 +237,21 @@ class Runtime:
         Retries across GCS restarts (ref: GcsClient auto-reconnect,
         _raylet.pyx:2111 _auto_reconnect) until gcs_reconnect_timeout_s."""
         deadline = time.time() + self.cfg.gcs_reconnect_timeout_s
+        client = self.pool.get(self.gcs_addr)
         while True:
             try:
-                return self._run(self.pool.get(self.gcs_addr).call(
-                    method, timeout=rpc_timeout, **kw))
+                out = self._run(client.call(method, timeout=rpc_timeout,
+                                            **kw))
+                # Resubscribe when the call ran over a NEWER connection
+                # than the last subscribe batch — catches failovers that
+                # happened while we were idle (the reconnect is silent;
+                # ConnectionLost may never surface to any caller).
+                if self._gcs_sub_gen is None:
+                    self._gcs_sub_gen = client.generation
+                elif client.generation != self._gcs_sub_gen:
+                    self._gcs_sub_gen = client.generation
+                    self._resubscribe_all()
+                return out
             except (ConnectionLost, OSError):
                 if self._shutdown or time.time() >= deadline:
                     raise
@@ -963,15 +976,37 @@ class Runtime:
         self._subscribe_actor(actor_id)
         return actor_id
 
-    def _subscribe_actor(self, actor_id: ActorID):
+    def _subscribe_channel(self, channel: str):
+        """Register a pubsub channel; remembered for resubscription after
+        a GCS failover (ref: GcsClient resubscribe on reconnect,
+        _raylet.pyx:2111 _auto_reconnect)."""
+        self._gcs_subs.add(channel)
+
         async def _sub():
             try:
                 await self.pool.get(self.gcs_addr).call(
-                    "subscribe", channel=f"actor:{actor_id.hex()}",
+                    "subscribe", channel=channel,
                     addr=self.address.addr, timeout=5.0)
             except Exception:
                 pass
         self._spawn(_sub())
+
+    def _resubscribe_all(self):
+        """After the GCS came back: re-register every channel (a
+        memory-storage GCS or one that died between snapshots lost its
+        subscriber table)."""
+        for ch in list(self._gcs_subs):
+            async def _sub(ch=ch):
+                try:
+                    await self.pool.get(self.gcs_addr).call(
+                        "subscribe", channel=ch, addr=self.address.addr,
+                        timeout=5.0)
+                except Exception:
+                    pass
+            self._spawn(_sub())
+
+    def _subscribe_actor(self, actor_id: ActorID):
+        self._subscribe_channel(f"actor:{actor_id.hex()}")
 
     async def rpc_pubsub_message(self, channel: str, message: Any):
         if channel.startswith("actor:"):
@@ -981,6 +1016,16 @@ class Runtime:
             ev = self._actor_events.get(aid)
             if ev:
                 ev.set()
+            if message.get("state") == "DEAD":
+                # terminal: prune the channel so _gcs_subs stays bounded
+                # and failover resubscription doesn't replay dead actors
+                self._gcs_subs.discard(channel)
+                try:
+                    await self.pool.get(self.gcs_addr).call(
+                        "unsubscribe", channel=channel,
+                        addr=self.address.addr, timeout=5.0)
+                except Exception:
+                    pass
         elif channel == "log":
             self._on_log(message)
 
@@ -997,14 +1042,7 @@ class Runtime:
                   file=stream)
 
     def subscribe_logs(self):
-        async def _sub():
-            try:
-                await self.pool.get(self.gcs_addr).call(
-                    "subscribe", channel="log", addr=self.address.addr,
-                    timeout=5.0)
-            except Exception:
-                pass
-        self._spawn(_sub())
+        self._subscribe_channel("log")
 
     def _resolve_actor(self, actor_id: ActorID, timeout: float = 60.0) -> Address:
         addr = self._actor_addr.get(actor_id)

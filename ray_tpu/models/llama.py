@@ -412,16 +412,19 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
         q = rope1((h @ lp["wq"].astype(dt)).reshape(B, 1, H, HD))
         k = rope1((h @ lp["wk"].astype(dt)).reshape(B, 1, KV, HD))
         v = (h @ lp["wv"].astype(dt)).reshape(B, 1, KV, HD)
-        upd = jax.vmap(
-            lambda c, kk, p, a: jax.lax.cond(
-                a > 0,
-                lambda: jax.lax.dynamic_update_slice(c, kk, (p, 0, 0)),
-                lambda: c))(ck, k.astype(ck.dtype)[:, 0][:, None], pos, active)
-        vpd = jax.vmap(
-            lambda c, kk, p, a: jax.lax.cond(
-                a > 0,
-                lambda: jax.lax.dynamic_update_slice(c, kk, (p, 0, 0)),
-                lambda: c))(cv, v.astype(cv.dtype)[:, 0][:, None], pos, active)
+        # Unconditional one-position write per row; inactive rows write
+        # back the value already there. A vmapped lax.cond would lower to
+        # SELECTs over the whole [S, KV, HD] cache per row (both branches
+        # materialized) — this form touches O(KV*HD) per row instead.
+        def write_at(c, new, p, a):
+            old = jax.lax.dynamic_slice(c, (p, 0, 0), new.shape)
+            val = jnp.where(a > 0, new, old)
+            return jax.lax.dynamic_update_slice(c, val, (p, 0, 0))
+
+        upd = jax.vmap(write_at)(ck, k.astype(ck.dtype)[:, 0][:, None],
+                                 pos, active)
+        vpd = jax.vmap(write_at)(cv, v.astype(cv.dtype)[:, 0][:, None],
+                                 pos, active)
         kk = upd.astype(dt)                                # [B, S, KV, HD]
         vv = vpd.astype(dt)
         # scores: q [B,1,H,HD] x kk [B,S,KV,HD], GQA groups

@@ -74,12 +74,42 @@ class LLMEngine:
         self.lock = threading.Lock()
         self.pending: List[_Request] = []
         self._next_id = 0
-        self._last_tokens = np.zeros((max_slots, 1), np.int32)
+        # device-resident decode state: last tokens, active mask, temps,
+        # PRNG key. Uploaded only when slot membership changes — per-block
+        # host->device transfers each cost a transport round trip
+        self._last = jnp.zeros((max_slots, 1), jnp.int32)
+        self._active_dev = jnp.zeros((max_slots,), jnp.int32)
+        self._temps_dev = jnp.zeros((max_slots,), jnp.float32)
+        self._key = jax.random.PRNGKey(seed ^ 0x5eed)
+        self._masks_dirty = True
 
         self._decode = jax.jit(
-            lambda p, t, c, a: llama.decode_step(p, t, c, cfg, active=a))
+            lambda p, t, c, a: llama.decode_step(p, t, c, cfg, active=a),
+            donate_argnums=(2,))  # cache aliases in place across calls
         self._prefill = jax.jit(
             lambda p, t, l: llama.prefill(p, t, l, cfg))  # noqa: E741
+
+        def _multi(params, last, cache, active, temps, key, n):
+            # n fused decode steps with ON-DEVICE sampling: one host
+            # round-trip per n tokens instead of per token (the per-step
+            # logits fetch dominates decode latency on any transport)
+            def body(carry, _):
+                last, cache, key = carry
+                logits, cache = llama.decode_step(params, last, cache, cfg,
+                                                  active=active)
+                key, sub = jax.random.split(key)
+                greedy = jnp.argmax(logits, axis=-1)
+                sampled = jax.random.categorical(
+                    sub, logits / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
+                tok = jnp.where(temps <= 0.0, greedy, sampled)
+                return (tok[:, None].astype(jnp.int32), cache, key), tok
+
+            (last, cache, key), toks = jax.lax.scan(
+                body, (last, cache, key), None, length=n)
+            return toks, last, cache, key  # toks: [n, slots]
+
+        self._decode_n = jax.jit(_multi, static_argnames="n",
+                                 donate_argnums=(2,))
 
         self.metrics = {"requests": 0, "tokens_generated": 0,
                         "ttft_sum": 0.0, "ttft_count": 0}
@@ -137,7 +167,10 @@ class LLMEngine:
         from ray_tpu.models.llama import KVCache
 
         self.cache = KVCache(k, v, length)
+        self._masks_dirty = True
         first = np.asarray(self._sample(logits, [r.temperature for r in admit]))
+        self._last = self._last.at[slots, 0].set(
+            jnp.asarray(first.astype(np.int32)))
         now = time.time()
         for i, r in enumerate(admit):
             tok = int(first[i])
@@ -146,7 +179,6 @@ class LLMEngine:
             self.metrics["ttft_sum"] += now - r.submit_time
             self.metrics["ttft_count"] += 1
             self.metrics["tokens_generated"] += 1
-            self._last_tokens[r.slot, 0] = tok
             self._maybe_finish(r)
 
     def _sample(self, logits, temps):
@@ -172,6 +204,7 @@ class LLMEngine:
                 if r.slot >= 0:
                     self.slots[r.slot] = None
                     r.slot = -1
+                    self._masks_dirty = True
             r.done_event.set()
 
     def step(self) -> int:
@@ -187,31 +220,81 @@ class LLMEngine:
         if not active_reqs:
             return 0
         logits, self.cache = self._decode(
-            self.params, jnp.asarray(self._last_tokens), self.cache,
-            jnp.asarray(active_mask))
+            self.params, self._last, self.cache, jnp.asarray(active_mask))
         temps = [0.0] * self.max_slots
         with self.lock:
             for r in self.slots:
                 if r is not None:
                     temps[r.slot] = r.temperature
         toks = np.asarray(self._sample(logits, temps))
+        self._last = jnp.asarray(toks[:, None].astype(np.int32))
         for r in list(active_reqs):
             if r.slot < 0:
                 continue
             tok = int(toks[r.slot])
             r.generated.append(tok)
             self.metrics["tokens_generated"] += 1
-            self._last_tokens[r.slot, 0] = tok
             self._maybe_finish(r)
         with self.lock:
             return sum(1 for s in self.slots if s is not None)
 
+    def step_n(self, n: int = 8) -> int:
+        """Admit, then run up to n FUSED decode steps (one host sync).
+        n is clamped so no active slot can outrun its token budget or the
+        cache; mid-block EOS costs a few wasted device steps (the slot's
+        surplus tokens are discarded host-side), the same trade vLLM-
+        style engines make for multi-step scheduling."""
+        import jax
+        import jax.numpy as jnp
+
+        self._admit()
+        with self.lock:
+            active_reqs = [r for r in self.slots if r is not None]
+            active_mask = np.array(
+                [1 if s is not None else 0 for s in self.slots], np.int32)
+            temps = np.zeros((self.max_slots,), np.float32)
+            for r in active_reqs:
+                temps[r.slot] = r.temperature
+        if not active_reqs:
+            return 0
+        n_eff = n
+        for r in active_reqs:
+            n_eff = min(n_eff,
+                        r.max_new_tokens - len(r.generated),
+                        self.max_seq - 1 - len(r.prompt) - len(r.generated))
+        # round DOWN to a power of two: every distinct n is a separate
+        # XLA compilation of the n-step scan, so bound the set to
+        # {1, 2, 4, ..., n} (same bucketing idea as prefill)
+        b = 1
+        while b * 2 <= n_eff:
+            b *= 2
+        n_eff = b
+        if n_eff <= 1:
+            return self.step()
+        if self._masks_dirty:
+            self._active_dev = jnp.asarray(active_mask)
+            self._temps_dev = jnp.asarray(temps)
+            self._masks_dirty = False
+        toks, self._last, self.cache, self._key = self._decode_n(
+            self.params, self._last, self.cache,
+            self._active_dev, self._temps_dev, self._key, n_eff)
+        toks = np.asarray(toks)  # the block's single host fetch
+        for r in list(active_reqs):
+            for j in range(n_eff):
+                if r.slot < 0:
+                    break  # finished mid-block; surplus tokens dropped
+                r.generated.append(int(toks[j, r.slot]))
+                self.metrics["tokens_generated"] += 1
+                self._maybe_finish(r)
+        with self.lock:
+            return sum(1 for s in self.slots if s is not None)
+
     def generate(self, prompt: List[int], max_new_tokens: int = 32,
-                 temperature: float = 0.0) -> List[int]:
+                 temperature: float = 0.0, decode_block: int = 8) -> List[int]:
         """Synchronous convenience: submit + drive until done."""
         req = self.submit(prompt, max_new_tokens, temperature)
         while not req.done_event.is_set():
-            self.step()
+            self.step_n(decode_block)
         return req.generated
 
 
@@ -220,9 +303,14 @@ class LLMServer:
     decode loop so concurrent requests batch continuously."""
 
     def __init__(self, preset: str = "tiny", max_slots: int = 8,
-                 eos_token: int = -1, params=None, cfg=None, **kw):
+                 eos_token: int = -1, params=None, cfg=None,
+                 decode_block: int = 8, **kw):
         self.engine = LLMEngine(cfg=cfg, params=params, preset=preset,
                                 max_slots=max_slots, eos_token=eos_token, **kw)
+        # fused decode steps per host sync (1 = lowest latency per token,
+        # higher = fewer host round-trips; new arrivals wait at most one
+        # block for admission)
+        self.decode_block = decode_block
         self._wake = threading.Event()
         self._stop = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -231,7 +319,7 @@ class LLMServer:
     def _loop(self):
         while not self._stop:
             if self.engine.has_work():
-                self.engine.step()
+                self.engine.step_n(self.decode_block)
             else:
                 self._wake.wait(timeout=0.01)
                 self._wake.clear()
