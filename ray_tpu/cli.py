@@ -104,6 +104,29 @@ def cmd_istats(args):
     print(json.dumps(ray_tpu.internal_stats(), indent=2, default=str))
 
 
+def cmd_debug(args):
+    """List active remote-pdb breakpoints and attach (ref: ray debug)."""
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import rpdb
+
+    sessions = rpdb.list_breakpoints()
+    if not sessions:
+        print("no active breakpoints")
+        return
+    for i, s in enumerate(sessions):
+        print(f"[{i}] pid={s.get('pid')} {s.get('host')}:{s.get('port')}")
+    if args.list:
+        return
+    if not 0 <= args.index < len(sessions):
+        print(f"no breakpoint session [{args.index}] "
+              f"({len(sessions)} active)")
+        return
+    s = sessions[args.index]
+    print(f"attaching to {s['host']}:{s['port']} — 'c' to continue, "
+          "'q' to quit")
+    rpdb.attach(s["host"], s["port"], token=s.get("token", ""))
+
+
 def cmd_gateway(args):
     """Serve remote drivers (ref: ray client server / proxier)."""
     import asyncio
@@ -235,6 +258,12 @@ def main():
     s.add_argument("--limit", type=int, default=10000)
     s.add_argument("--output", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("debug", help="attach to a remote-pdb breakpoint")
+    s.add_argument("--address", required=True)
+    s.add_argument("--index", type=int, default=0)
+    s.add_argument("--list", action="store_true")
+    s.set_defaults(fn=cmd_debug)
 
     s = sub.add_parser("gateway", help="run a client gateway "
                        "(remote drivers: python thin client + C++ API)")

@@ -16,7 +16,8 @@ from ray_tpu.data.dataset import (Dataset, DataIterator, from_arrow,
                                   from_items, from_numpy, from_pandas,
                                   range as range_, read_binary_files,
                                   read_csv, read_images, read_json,
-                                  read_parquet, read_text, read_tfrecords)
+                                  read_parquet, read_sql, read_text,
+                                  read_tfrecords, read_webdataset, write_sql)
 from ray_tpu.data import aggregate, preprocessors
 from ray_tpu.data.grouped import GroupedData
 
@@ -26,6 +27,7 @@ range = range_
 __all__ = [
     "Dataset", "DataIterator", "from_arrow", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv", "read_images",
-    "read_json", "read_parquet", "read_text", "read_tfrecords", "aggregate",
+    "read_json", "read_parquet", "read_sql", "read_text", "read_tfrecords",
+    "read_webdataset", "write_sql", "aggregate",
     "preprocessors", "GroupedData",
 ]

@@ -1020,3 +1020,122 @@ def from_arrow(table, *, num_blocks: int = 8) -> Dataset:
     return from_numpy(
         {c: table[c].to_numpy(zero_copy_only=False)
          for c in table.column_names}, num_blocks=num_blocks)
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = 1) -> Dataset:
+    """Read query results into a Dataset (ref: datasource/sql_datasource.py
+    — any DBAPI2 connection factory; sqlite3 in-image, client libraries
+    for other engines plug in the same way). `parallelism` splits with
+    LIMIT/OFFSET pagination when > 1 (same strategy as the reference)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _query(page: Optional[Tuple[int, int]]):
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            if page is None:
+                cur.execute(sql)
+            else:
+                # integers inlined (no driver paramstyle dependency) and
+                # the derived table aliased (PostgreSQL/MySQL require it)
+                off, lim = int(page[0]), int(page[1])
+                cur.execute(f"SELECT * FROM ({sql}) AS _rt_page "
+                            f"LIMIT {lim} OFFSET {off}")
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        return {c: np.asarray([r[i] for r in rows])
+                for i, c in enumerate(cols)}
+
+    if parallelism <= 1:
+        refs = [_query.remote(None)]
+    else:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(f"SELECT COUNT(*) FROM ({sql}) AS _rt_count")
+            (total,) = cur.fetchone()
+        finally:
+            conn.close()
+        per = max((total + parallelism - 1) // parallelism, 1)
+        refs = [_query.remote((off, per))
+                for off in builtins.range(0, max(total, 1), per)]
+    return Dataset(refs, [])
+
+
+def write_sql(ds: "Dataset", table: str, connection_factory,
+              *, if_exists: str = "append") -> int:
+    """Write a Dataset into a DBAPI2 table; returns rows written
+    (ref: Dataset.write_sql)."""
+    import ray_tpu
+
+    total = 0
+    blocks = ray_tpu.get(ds._executed_refs())
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        first = True
+        for block in blocks:
+            if not isinstance(block, dict):
+                block = _rows_to_block(block)
+            if not isinstance(block, dict) or not block:
+                continue  # block emptied by transforms
+            cols = list(block)
+            n = len(block[cols[0]])
+            if n == 0:
+                continue
+            if first and if_exists == "replace":
+                cur.execute(f"DROP TABLE IF EXISTS {table}")
+            if first:
+                decls = ", ".join(f'"{c}"' for c in cols)
+                cur.execute(
+                    f"CREATE TABLE IF NOT EXISTS {table} ({decls})")
+                first = False
+            ph = ", ".join("?" * len(cols))
+            rows = [tuple(_py_scalar(block[c][i]) for c in cols)
+                    for i in builtins.range(n)]
+            cur.executemany(f"INSERT INTO {table} VALUES ({ph})", rows)
+            total += n
+        conn.commit()
+    finally:
+        conn.close()
+    return total
+
+
+def _py_scalar(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def read_webdataset(paths) -> Dataset:
+    """WebDataset-style tar shards: files grouped by basename stem, one
+    row per sample keyed by extension (ref: datasource/webdataset_datasource.py;
+    the format itself is just POSIX tar, stdlib-readable)."""
+    def reader(path):
+        import tarfile
+
+        samples: Dict[str, dict] = {}
+        order: List[str] = []
+        with tarfile.open(path, "r") as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                stem, _, ext = m.name.partition(".")
+                if stem not in samples:
+                    samples[stem] = {"__key__": stem}
+                    order.append(stem)
+                data = tf.extractfile(m).read()
+                if ext in ("txt", "cls", "json"):
+                    data = data.decode("utf-8", errors="replace")
+                    if ext == "json":
+                        import json as _json
+
+                        data = _json.loads(data)
+                samples[stem][ext] = data
+        return _rows_to_block([samples[k] for k in order])
+
+    return _read_files(paths, reader)

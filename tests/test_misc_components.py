@@ -139,3 +139,65 @@ def test_stack_and_internal_stats(ray_start_regular):
 
     assert ray_tpu.get(ref) == "done"
     ray_tpu.kill(s)
+
+
+def test_remote_pdb_breakpoint(ray_start_regular):
+    """ref: util/rpdb.py + `ray debug` — a task hits set_trace, the
+    client attaches over TCP, inspects a variable, and continues."""
+    import json
+    import socket
+    import time as _time
+
+    @ray_tpu.remote
+    def buggy():
+        from ray_tpu.util import rpdb
+
+        secret = 1234
+        rpdb.set_trace()
+        return secret + 1
+
+    ref = buggy.remote()
+
+    # wait for the breakpoint to register
+    from ray_tpu.util import rpdb
+
+    deadline = _time.time() + 60
+    sessions = []
+    while _time.time() < deadline and not sessions:
+        sessions = rpdb.list_breakpoints()
+        _time.sleep(0.2)
+    assert sessions, "breakpoint never registered"
+    s = sessions[0]
+
+    # wrong token is rejected before any pdb access
+    bad = socket.create_connection((s["host"], s["port"]), timeout=30)
+    bad.sendall(b"wrong-token\n")
+    assert b"bad token" in bad.recv(64)
+    bad.close()
+
+    conn = socket.create_connection((s["host"], s["port"]), timeout=30)
+    conn.settimeout(30)
+    conn.sendall((s["token"] + "\n").encode())
+
+    def read_until(marker: bytes) -> bytes:
+        buf = b""
+        while marker not in buf:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        return buf
+
+    banner = read_until(b"(ray_tpu-pdb) ")
+    assert b"set_trace" in banner or b"buggy" in banner
+    conn.sendall(b"p secret\n")
+    out = read_until(b"(ray_tpu-pdb) ")
+    assert b"1234" in out
+    conn.sendall(b"c\n")
+    assert ray_tpu.get(ref, timeout=60) == 1235
+    conn.close()
+    # session deregistered
+    deadline = _time.time() + 10
+    while _time.time() < deadline and rpdb.list_breakpoints():
+        _time.sleep(0.2)
+    assert not rpdb.list_breakpoints()
