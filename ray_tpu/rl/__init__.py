@@ -25,6 +25,8 @@ from ray_tpu.rl.multi_agent import (MultiAgentEnv, MultiAgentPPOConfig,
                                     MultiAgentPPOTrainer,
                                     register_multi_agent_env)
 from ray_tpu.rl.offline import BCConfig, BCTrainer, CQLConfig, CQLTrainer
+from ray_tpu.rl.policy_server import (ExternalPPOConfig, ExternalPPOTrainer,
+                                      PolicyClient, PolicyServer)
 from ray_tpu.rl.ppo import PPOConfig, PPOTrainer
 from ray_tpu.rl.sac import SACConfig, SACTrainer
 from ray_tpu.rl.td3 import TD3Config, TD3Trainer
@@ -62,4 +64,6 @@ __all__ = [
     "Learner", "LearnerGroup", "LearnerSpec",
     "Connector", "ConnectorPipeline", "NormalizeObs", "FrameStack",
     "FlattenObs", "ClipObs",
+    "PolicyServer", "PolicyClient", "ExternalPPOConfig",
+    "ExternalPPOTrainer",
 ]

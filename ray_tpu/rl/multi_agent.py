@@ -23,7 +23,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.rl.core import Algorithm, episode_stats_from
 from ray_tpu.rl.ppo import (categorical_sample, compute_gae, init_policy,
-                            make_ppo_update, policy_forward)
+                            make_ppo_update, policy_forward, run_ppo_epochs)
 
 
 class MultiAgentEnv:
@@ -282,7 +282,6 @@ class MultiAgentPPOTrainer(Algorithm):
                            * cfg.num_rollout_workers)
         agent_steps = 0
         aux_by_pid = {}
-        rng = np.random.default_rng(self.iteration)
         for pid in self.train_ids:
             batches = per_policy.get(pid, [])
             if not batches:
@@ -292,26 +291,16 @@ class MultiAgentPPOTrainer(Algorithm):
                 adv, ret = compute_gae(b, cfg.gamma, cfg.lam)
                 obs.append(b["obs"]); acts.append(b["actions"])
                 logps.append(b["logp"]); advs.append(adv); rets.append(ret)
-            obs = np.concatenate(obs); acts = np.concatenate(acts)
-            logps = np.concatenate(logps)
-            advs = np.concatenate(advs)
-            advs = (advs - advs.mean()) / (advs.std() + 1e-8)
-            rets = np.concatenate(rets)
-            n = len(obs)
-            agent_steps += n
-            aux = {}
-            for _ in range(cfg.num_epochs):
-                perm = rng.permutation(n)
-                for lo in range(0, n, cfg.minibatch_size):
-                    idx = perm[lo:lo + cfg.minibatch_size]
-                    if len(idx) < 2:
-                        continue
-                    mb = {"obs": obs[idx], "actions": acts[idx],
-                          "logp": logps[idx], "adv": advs[idx],
-                          "returns": rets[idx]}
-                    (self.policies[pid], self.opt_states[pid],
-                     aux) = self._update(self.policies[pid],
-                                         self.opt_states[pid], mb)
+            obs = np.concatenate(obs)
+            agent_steps += len(obs)
+            (self.policies[pid], self.opt_states[pid],
+             aux) = run_ppo_epochs(
+                self._update, self.policies[pid], self.opt_states[pid],
+                obs=obs, actions=np.concatenate(acts),
+                logp=np.concatenate(logps), adv=np.concatenate(advs),
+                returns=np.concatenate(rets),
+                num_epochs=cfg.num_epochs,
+                minibatch_size=cfg.minibatch_size, seed=self.iteration)
             aux_by_pid[pid] = {k: float(v) for k, v in aux.items()}
 
         stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])

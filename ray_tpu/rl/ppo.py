@@ -180,6 +180,31 @@ def make_ppo_update(cfg, opt):
     return update
 
 
+def run_ppo_epochs(update, params, opt_state, *, obs, actions, logp, adv,
+                   returns, num_epochs: int, minibatch_size: int, seed: int):
+    """The shared epoch/minibatch drive used by every PPO-family trainer:
+    normalize advantages, then num_epochs passes of shuffled FULL
+    minibatches (constant shape -> exactly one XLA compilation of
+    `update`; a variable-length remainder would recompile per
+    iteration). With fewer than minibatch_size rows, indices wrap."""
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    n = len(obs)
+    mbs = minibatch_size
+    rng = np.random.default_rng(seed)
+    aux = {}
+    for _ in range(num_epochs):
+        perm = rng.permutation(n)
+        if n < mbs:
+            perm = np.resize(perm, mbs)      # wrap: one full minibatch
+        for lo in range(0, len(perm) - mbs + 1, mbs):
+            idx = perm[lo:lo + mbs]
+            mb = {"obs": obs[idx], "actions": actions[idx],
+                  "logp": logp[idx], "adv": adv[idx],
+                  "returns": returns[idx]}
+            params, opt_state, aux = update(params, opt_state, mb)
+    return params, opt_state, aux
+
+
 @dataclass
 class PPOConfig:
     env: str = "CartPole-v1"
@@ -267,26 +292,14 @@ class PPOTrainer:
             advs.append(adv)
             rets.append(ret)
         obs = np.concatenate(obs)
-        acts = np.concatenate(acts)
-        logps = np.concatenate(logps)
-        advs = np.concatenate(advs)
-        advs = (advs - advs.mean()) / (advs.std() + 1e-8)
-        rets = np.concatenate(rets)
-
         n = len(obs)
-        rng = np.random.default_rng(self.iteration)
-        aux = {}
-        for _ in range(self.cfg.num_epochs):
-            perm = rng.permutation(n)
-            for lo in range(0, n, self.cfg.minibatch_size):
-                idx = perm[lo:lo + self.cfg.minibatch_size]
-                if len(idx) < 2:
-                    continue
-                mb = {"obs": obs[idx], "actions": acts[idx],
-                      "logp": logps[idx], "adv": advs[idx],
-                      "returns": rets[idx]}
-                self.params, self.opt_state, aux = self._update(
-                    self.params, self.opt_state, mb)
+        self.params, self.opt_state, aux = run_ppo_epochs(
+            self._update, self.params, self.opt_state,
+            obs=obs, actions=np.concatenate(acts),
+            logp=np.concatenate(logps), adv=np.concatenate(advs),
+            returns=np.concatenate(rets),
+            num_epochs=self.cfg.num_epochs,
+            minibatch_size=self.cfg.minibatch_size, seed=self.iteration)
 
         stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
         mean_ret = float(np.mean([s["mean_return"] for s in stats
