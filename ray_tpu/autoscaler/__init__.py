@@ -12,6 +12,8 @@ FakeMultiNodeProvider, fake_multi_node/node_provider.py:237).
 """
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
-from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
+                                              NodeProvider, TPUPodProvider)
 
-__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider"]
+__all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider",
+           "TPUPodProvider"]

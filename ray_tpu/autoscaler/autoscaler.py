@@ -27,6 +27,19 @@ class StandardAutoscaler:
         self.idle_timeout_s = idle_timeout_s
         self._idle_since: Dict[str, float] = {}
 
+    def _label_map(self) -> Dict[str, str]:
+        """provider_node_id label -> GCS node id, for providers whose
+        node_id_of can't resolve (cloud slices)."""
+        out: Dict[str, str] = {}
+        try:
+            for n in self.gcs_call("get_nodes"):
+                pid = (n.labels or {}).get("provider_node_id")
+                if pid and n.alive:
+                    out[pid] = n.node_id.hex()
+        except Exception:
+            pass
+        return out
+
     def _pick_type(self, demand: Dict[str, float]) -> Optional[str]:
         req = ResourceSet({k: float(v) for k, v in demand.items()})
         for name, res in self.node_types.items():
@@ -58,8 +71,13 @@ class StandardAutoscaler:
         # scale down idle autoscaled nodes
         now = time.time()
         idle_gcs = set(load["idle_nodes"])
+        label_map = self._label_map()
         for pname in self.provider.non_terminated_nodes():
             gcs_id = getattr(self.provider, "node_id_of", lambda _: None)(pname)
+            if gcs_id is None:
+                # cloud providers can't know GCS ids; slices register
+                # their nodelet with labels={"provider_node_id": name}
+                gcs_id = label_map.get(pname)
             if gcs_id is not None and gcs_id in idle_gcs:
                 since = self._idle_since.setdefault(pname, now)
                 if now - since > self.idle_timeout_s:

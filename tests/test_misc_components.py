@@ -201,3 +201,62 @@ def test_remote_pdb_breakpoint(ray_start_regular):
     while _time.time() < deadline and rpdb.list_breakpoints():
         _time.sleep(0.2)
     assert not rpdb.list_breakpoints()
+
+
+def test_tpu_pod_provider_command_protocol():
+    """ref: cloud NodeProviders — slice-granular scaling over Queued
+    Resources, exercised through an injected command runner."""
+    import json as _json
+
+    from ray_tpu.autoscaler.node_provider import TPUPodProvider
+
+    calls = []
+    state = {}
+
+    def fake_gcloud(args):
+        calls.append(args)
+        if args[4] == "create":
+            name = args[5]
+            state[name] = "PROVISIONING"
+            return ""
+        if args[4] == "delete":
+            state.pop(args[5], None)
+            return ""
+        if args[4] == "list":
+            return _json.dumps(
+                [{"name": f"projects/p/locations/z/queuedResources/{n}",
+                  "state": {"state": s}} for n, s in state.items()])
+        raise AssertionError(args)
+
+    p = TPUPodProvider(
+        project="proj", zone="us-central1-a",
+        node_types={"v5e-8": {"accelerator_type": "v5litepod-8"}},
+        runner=fake_gcloud, cluster_name="c1",
+        startup_script="#!/bin/bash\necho a, b\n")
+
+    nid = p.create_node("v5e-8", {"TPU": 8})
+    assert nid.startswith("ray-tpu-c1-v5e-8-")
+    create = calls[0]
+    assert create[:5] == ["alpha", "compute", "tpus", "queued-resources",
+                          "create"]
+    assert "--accelerator-type=v5litepod-8" in create
+    assert "--zone=us-central1-a" in create
+    # scripts must ride --metadata-from-file (commas break --metadata)
+    assert any(a.startswith("--metadata-from-file=startup-script=")
+               for a in create)
+    # a second create never collides even across 'restarts'
+    nid2 = p.create_node("v5e-8", {"TPU": 8})
+    assert nid2 != nid
+    p.terminate_node(nid2)
+    # foreign queued resources in the same project/zone are ignored
+    state["other-cluster-qr-1"] = "ACTIVE"
+    assert p.non_terminated_nodes() == [nid]
+
+    state[nid] = "ACTIVE"
+    assert p.non_terminated_nodes() == [nid]
+    state[nid] = "FAILED"
+    assert p.non_terminated_nodes() == []
+
+    state[nid] = "ACTIVE"
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
