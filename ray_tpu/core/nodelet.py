@@ -58,6 +58,7 @@ class WorkerRecord:
         self.last_idle = time.time()
         self.lease_time = 0.0          # when the current lease was granted
         self.retriable = True          # current task retries on worker death
+        self.resources_released = False  # blocked in get(); CPU given back
         self.actor_id = None           # set when this worker hosts an actor
         self.ready = asyncio.Event()
 
@@ -348,6 +349,43 @@ class Nodelet:
         w.ready.set()
         return {"ok": True}
 
+    async def rpc_worker_blocked(self, worker_id: bytes) -> dict:
+        """A leased worker is blocking in get(): give its lease's
+        resources back to the pool so what it waits on can schedule
+        (ref: NotifyDirectCallTaskBlocked -> raylet releases CPU)."""
+        w = self.workers.get(worker_id)
+        # actors too: an actor blocking in get() holds its creation
+        # resources; releasing them is what prevents actor-getter fleets
+        # from deadlocking the node
+        if w is None or w.state not in ("leased", "actor") \
+                or w.lease_id is None or w.resources_released:
+            return {"ok": False}
+        entry = self.lease_resources.get(w.lease_id)
+        if entry is None:
+            return {"ok": False}
+        resources, pg = entry
+        pool = self._resource_pool(pg)
+        if pool is not None:
+            pool.add(resources)
+        w.resources_released = True
+        self._drain_pending()
+        return {"ok": True}
+
+    async def rpc_worker_unblocked(self, worker_id: bytes) -> dict:
+        """Re-subtract on unblock; transient oversubscription is allowed
+        (the reference reacquires the same way)."""
+        w = self.workers.get(worker_id)
+        if w is None or not w.resources_released or w.lease_id is None:
+            return {"ok": False}
+        entry = self.lease_resources.get(w.lease_id)
+        if entry is not None:
+            resources, pg = entry
+            pool = self._resource_pool(pg)
+            if pool is not None:
+                pool.subtract(resources)
+        w.resources_released = False
+        return {"ok": True}
+
     async def rpc_dump_worker_stacks(self) -> dict:
         """Fan a stack-dump request to every live worker on this node,
         concurrently — hung workers (the thing `ray stack` debugs) must
@@ -375,6 +413,15 @@ class Nodelet:
             self._kill_worker(w, reason or "requested")
         return {"ok": True}
 
+    def _countable_workers(self) -> int:
+        """Pool occupancy for the max_workers cap. Workers blocked in
+        get() don't count — their resources are released and the work
+        they wait on may need a fresh worker here (the reference's pool
+        grows past the soft cap for exactly this reason; a hard cap
+        would deadlock getter fleets)."""
+        return sum(1 for w in self.workers.values()
+                   if not w.resources_released)
+
     async def _pop_worker(self, env_vars=None) -> Optional[WorkerRecord]:
         """Pop an idle worker from the pool keyed by the process-env hash
         (ref: worker_pool.h:156 runtime-env-keyed pools). Workers from a
@@ -384,7 +431,7 @@ class Nodelet:
         for w in self.workers.values():
             if w.state == "idle" and w.env_key == key:
                 return w
-        if len(self.workers) < self.cfg.max_workers_per_node:
+        if self._countable_workers() < self.cfg.max_workers_per_node:
             return await self._start_worker(env_vars)
         # Saturated: evict an idle worker from another pool to make room
         # (the reference kills idle workers of stale envs under pressure).
@@ -401,7 +448,7 @@ class Nodelet:
             for w in self.workers.values():
                 if w.state == "idle" and w.env_key == key:
                     return w
-            if len(self.workers) < self.cfg.max_workers_per_node:
+            if self._countable_workers() < self.cfg.max_workers_per_node:
                 return await self._start_worker(env_vars)
             for w in list(self.workers.values()):
                 if w.state == "idle" and w.env_key != key:
@@ -501,11 +548,15 @@ class Nodelet:
     def _release_lease(self, lease_id: bytes):
         w = self.leases.pop(lease_id, None)
         entry = self.lease_resources.pop(lease_id, None)
-        if entry is not None:
+        if entry is not None and not (
+                w is not None and getattr(w, "resources_released", False)):
+            # skip the add if the blocked-get path already returned them
             resources, pg = entry
             pool = self._resource_pool(pg)
             if pool is not None:
                 pool.add(resources)
+        if w is not None:
+            w.resources_released = False
         if w is not None and w.state == "leased":
             w.state = "idle"
             w.lease_id = None

@@ -162,6 +162,7 @@ class Runtime:
         # appended from executor threads (spans), swapped on the loop
         self._task_events_lock = threading.Lock()
         self._gcs_subs: Set[str] = set()  # channels to restore on failover
+        self._recon_lock = threading.Lock()  # serializes reconstructions
         self._gcs_sub_gen: Optional[int] = None  # conn generation at last sub
         self.address: Optional[RuntimeAddress] = None
         self._started = False
@@ -401,7 +402,42 @@ class Runtime:
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         """ref: CoreWorker::Get core_worker.cc:1331."""
         deadline = None if timeout is None else time.time() + timeout
-        return [self._get_one(r, deadline) for r in refs]
+        depth = getattr(self._exec_ctx, "get_depth", 0)
+        self._exec_ctx.get_depth = depth + 1
+        try:
+            return [self._get_one(r, deadline) for r in refs]
+        finally:
+            self._exec_ctx.get_depth = depth
+            if depth == 0:
+                self._end_block()
+
+    def _ensure_blocked(self):
+        """Called LAZILY from the wait paths, just before the first
+        actual block: a worker blocking in get() releases its lease's
+        resources so the tasks it waits on can schedule — without this a
+        fleet of getters deadlocks the cluster (ref:
+        NotifyDirectCallTaskBlocked). Gets that resolve locally never
+        notify, keeping the hot path RPC-free."""
+        if self.mode != "worker" \
+                or getattr(self._exec_ctx, "task_id", None) is None \
+                or getattr(self._exec_ctx, "block_notified", False):
+            return
+        self._exec_ctx.block_notified = True
+        try:
+            self.node_call(self.nodelet_addr, "worker_blocked",
+                           worker_id=self.worker_id, rpc_timeout=5.0)
+        except Exception:
+            pass
+
+    def _end_block(self):
+        if not getattr(self._exec_ctx, "block_notified", False):
+            return
+        self._exec_ctx.block_notified = False
+        try:
+            self.node_call(self.nodelet_addr, "worker_unblocked",
+                           worker_id=self.worker_id, rpc_timeout=5.0)
+        except Exception:
+            pass
 
     def _remaining(self, deadline: Optional[float]) -> Optional[float]:
         if deadline is None:
@@ -445,6 +481,8 @@ class Runtime:
     def _get_owned(self, ref: ObjectRef, deadline: Optional[float], _depth: int) -> Any:
         oid = ref.id
         e = self._entry(oid)
+        if not e.event.is_set():
+            self._ensure_blocked()
         while True:
             rem = self._remaining(deadline)
             if not e.event.wait(timeout=rem if rem is not None else 1.0):
@@ -472,6 +510,7 @@ class Runtime:
     def _get_borrowed(self, ref: ObjectRef, deadline: Optional[float], _depth: int) -> Any:
         oid = ref.id
         owner = ref.owner
+        self._ensure_blocked()
         while True:
             rem = self._remaining(deadline)
             step = min(rem, 5.0) if rem is not None else 5.0
@@ -490,10 +529,28 @@ class Runtime:
                 raise ObjectLostError(f"object {oid.hex()[:12]} lost at owner")
             if r.get("inline") is not None:
                 return serialization.unpack(r["inline"])
-            val = self._fetch_from_locations(oid, [tuple(a) for a in r["locations"]])
+            locs = [tuple(a) for a in r["locations"]]
+            val = self._fetch_from_locations(oid, locs)
             if val is _MISSING:
-                raise ObjectLostError(
-                    f"object {oid.hex()[:12]} not reachable from any location")
+                # Every advertised copy is gone (their nodes died). Tell
+                # the owner so it prunes the locations and re-executes
+                # lineage; then retry the wait — bounded by the get
+                # deadline (ref: borrower pull failures feeding
+                # ObjectRecoveryManager).
+                try:
+                    rr = self._run(self.pool.get(owner.addr).call(
+                        "recover_object", oid=oid, dead_locations=locs,
+                        timeout=10.0), timeout=15.0)
+                except (ConnectionLost, RemoteError, OSError,
+                        TimeoutError) as err:
+                    raise ObjectLostError(
+                        f"owner of {oid.hex()[:12]} unreachable during "
+                        f"recovery: {err}") from None
+                if rr["status"] == "unrecoverable":
+                    raise ObjectLostError(
+                        f"object {oid.hex()[:12]} lost and not "
+                        "reconstructable")
+                continue  # owner is reconstructing (or has other copies)
             return val
 
     def _fetch_from_locations(self, oid: ObjectID, locations: List[Address]):
@@ -518,6 +575,25 @@ class Runtime:
         v = self._read_local(oid)
         return v
 
+    def _reset_and_resubmit(self, spec: TaskSpec) -> bool:
+        """Atomically flip the producing task's returns to pending and
+        resubmit — shared by owner-side and borrower-triggered recovery.
+        Returns False when another thread already has a reconstruction in
+        flight (check-then-submit must be one critical section or the two
+        paths double-execute and double-decrement arg refcounts)."""
+        with self._recon_lock:
+            entries = [self._entry(rid) for rid in spec.return_ids()]
+            if any(en.state == "pending" for en in entries):
+                return False
+            for rid, re_ in zip(spec.return_ids(), entries):
+                re_.state = "pending"
+                re_.inline = None
+                re_.locations = set()
+                re_.event.clear()
+                self.refs.register_owned(rid)
+        self._submit_spec(spec, retries_left=spec.max_retries)
+        return True
+
     def _try_reconstruct(self, ref: ObjectRef, deadline: Optional[float], _depth: int) -> Any:
         """Lineage reconstruction (ref: object_recovery_manager.h — re-execute
         the producing task)."""
@@ -527,15 +603,7 @@ class Runtime:
             raise ObjectLostError(
                 f"object {oid.hex()[:12]} lost and not reconstructable")
         logger.warning("reconstructing %s via lineage", oid.hex()[:12])
-        spec = e.spec
-        for rid in spec.return_ids():
-            re_ = self._entry(rid)
-            re_.state = "pending"
-            re_.inline = None
-            re_.locations = set()
-            re_.event.clear()
-            self.refs.register_owned(rid)
-        self._submit_spec(spec, retries_left=spec.max_retries)
+        self._reset_and_resubmit(e.spec)
         return self._get_one(ref, deadline, _depth + 1)
 
     # --- wait ---------------------------------------------------------------
@@ -1183,6 +1251,41 @@ class Runtime:
             return {"status": "ready", "inline": serialization.pack(v)}
         return {"status": "ready", "inline": None,
                 "locations": [list(a) for a in e.locations]}
+
+    async def rpc_recover_object(self, oid: ObjectID,
+                                 dead_locations=None) -> dict:
+        """A borrower failed to fetch from every advertised location:
+        prune locations whose NODES are confirmed dead (the borrower's
+        claim alone may be a transient network error — pruning a live
+        holder would leak its pinned primary and re-execute needlessly),
+        then re-execute lineage if no copy remains (the borrower-
+        initiated half of ObjectRecoveryManager)."""
+        e = self._entry(oid)
+        reported = {tuple(a) for a in (dead_locations or [])}
+        if reported:
+            try:
+                nodes = await self.pool.get(self.gcs_addr).call(
+                    "get_nodes", timeout=10.0)
+                alive_addrs = {tuple(n.nodelet_addr) for n in nodes
+                               if n.alive}
+            except Exception:
+                alive_addrs = None  # GCS unreachable: don't prune
+            if alive_addrs is not None:
+                for a in reported:
+                    if a not in alive_addrs:
+                        e.locations.discard(a)
+        if e.locations or e.inline is not None \
+                or self.memory_store.get_if_exists(oid) is not _MISSING:
+            return {"status": "has_copies"}
+        if e.spec is None:
+            e.state = "lost"
+            e.event.set()
+            return {"status": "unrecoverable"}
+        if e.state != "pending":
+            logger.warning("reconstructing %s via lineage "
+                           "(borrower-reported loss)", oid.hex()[:12])
+            self._reset_and_resubmit(e.spec)
+        return {"status": "reconstructing"}
 
     async def rpc_locate(self, oid: ObjectID) -> dict:
         with self._dir_lock:

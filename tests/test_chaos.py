@@ -1,0 +1,85 @@
+"""Chaos: random node kills under load (ref: test_chaos.py +
+NodeKillerActor _private/test_utils.py:1400 and the release chaos
+suites, release/nightly_tests/chaos_test/).
+
+The driver node survives; worker nodes die at random while a stream of
+retriable tasks runs. Every task must complete — via owner-side retries
+(task_manager retries) and spillback to surviving nodes."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_tasks_survive_random_node_kills(ray_start_cluster):
+    cluster = ray_start_cluster
+    # head (driver) node + three killable worker nodes; head has no CPU
+    # so work always lands on the victims' nodes
+    cluster.add_node(resources={"CPU": 0.001})
+    victims = [cluster.add_node(resources={"CPU": 2.0}) for _ in range(3)]
+    cluster.connect()
+
+    @ray_tpu.remote(max_retries=10)
+    def work(i, delay):
+        time.sleep(delay)
+        return i * 7
+
+    rng = random.Random(0)
+    stop = threading.Event()
+    killed = []
+
+    def killer():
+        """ref: NodeKillerActor — kill a random worker node, then
+        replace it so the cluster keeps capacity."""
+        while not stop.is_set():
+            time.sleep(rng.uniform(1.0, 2.0))
+            if not victims:
+                return
+            idx = rng.randrange(len(victims))
+            victims[idx].kill()
+            killed.append(victims[idx].node_id_hex)
+            victims[idx] = cluster.add_node(resources={"CPU": 2.0})
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        refs = [work.remote(i, rng.uniform(0.05, 0.4)) for i in range(60)]
+        out = ray_tpu.get(refs, timeout=240)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert out == [i * 7 for i in range(60)]
+    assert killed, "chaos thread never killed a node"
+
+
+def test_objects_survive_owner_visible_kill(ray_start_cluster):
+    """Objects whose primary copy dies are reconstructed from lineage
+    while chaos is ongoing (ref: test_reconstruction under chaos)."""
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 0.001})
+    n1 = cluster.add_node(resources={"CPU": 2.0})
+    cluster.connect()
+
+    @ray_tpu.remote(max_retries=5)
+    def make_block(seed):
+        rng = np.random.default_rng(seed)
+        return rng.normal(size=(256, 256))  # big enough for the store
+
+    @ray_tpu.remote(max_retries=5)
+    def checksum(a):
+        return float(np.sum(a))
+
+    refs = [make_block.remote(s) for s in range(8)]
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+    # kill the node holding the primaries; add a replacement
+    n1.kill()
+    cluster.add_node(resources={"CPU": 2.0})
+    sums = ray_tpu.get([checksum.remote(r) for r in refs], timeout=240)
+    expect = [float(np.sum(np.random.default_rng(s).normal(
+        size=(256, 256)))) for s in range(8)]
+    np.testing.assert_allclose(sums, expect, rtol=1e-10)
