@@ -40,7 +40,10 @@ class FilesystemStorage:
     def spill(self, oid: ObjectID, data: memoryview | bytes) -> str:
         nbytes = data.nbytes if isinstance(data, memoryview) else len(data)
         path = os.path.join(self.root, oid.hex())
-        tmp = path + ".tmp"
+        # unique tmp per attempt: concurrent spills of the SAME object
+        # (periodic spill loop vs put-pressure free_space) must not share
+        # a tmp path, or one racer renames it away under the other
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, path)  # atomic: readers never see partial files

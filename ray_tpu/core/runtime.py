@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import random
 import logging
 import threading
 import time
@@ -71,12 +72,16 @@ class _ObjectEntry:
     """Owner-side directory entry (ref: ObjectDirectory + memory store)."""
 
     __slots__ = ("state", "inline", "locations", "error", "event", "spec",
-                 "size")
+                 "size", "primaries")
 
     def __init__(self):
         self.state = "pending"        # pending | ready | error | lost
         self.inline: Optional[bytes] = None
         self.locations: Set[Address] = set()
+        # locations written at produce/put time; pinned on their nodes,
+        # never pruned on an unverified claim (secondaries are evictable
+        # and get dropped when a pull misses)
+        self.primaries: Set[Address] = set()
         self.error = None             # SerializedException
         self.event = threading.Event()
         self.spec: Optional[TaskSpec] = None   # lineage for reconstruction
@@ -325,6 +330,7 @@ class Runtime:
             if _pin:
                 self._pin_primary(oid)
             e.locations.add(self.nodelet_addr)
+            e.primaries.add(self.nodelet_addr)
             e.size = size
         e.state = "ready"
         e.event.set()
@@ -501,8 +507,11 @@ class Runtime:
             return v
         if e.inline is not None:
             return serialization.unpack(e.inline)
-        # value lives in some node store
-        val = self._fetch_from_locations(oid, list(e.locations))
+        # value lives in some node store (snapshot under the lock:
+        # puller registrations mutate the set concurrently)
+        with self._dir_lock:
+            locs = list(e.locations)
+        val = self._fetch_from_locations(oid, locs, owner=self.address)
         if val is _MISSING:
             return self._try_reconstruct(ref, deadline, _depth)
         return val
@@ -530,7 +539,7 @@ class Runtime:
             if r.get("inline") is not None:
                 return serialization.unpack(r["inline"])
             locs = [tuple(a) for a in r["locations"]]
-            val = self._fetch_from_locations(oid, locs)
+            val = self._fetch_from_locations(oid, locs, owner=owner)
             if val is _MISSING:
                 # Every advertised copy is gone (their nodes died). Tell
                 # the owner so it prunes the locations and re-executes
@@ -553,14 +562,22 @@ class Runtime:
                 continue  # owner is reconstructing (or has other copies)
             return val
 
-    def _fetch_from_locations(self, oid: ObjectID, locations: List[Address]):
+    def _fetch_from_locations(self, oid: ObjectID, locations: List[Address],
+                              owner: Optional[RuntimeAddress] = None):
         if self.store.contains(oid):
             v = self._read_local(oid)
             if v is not _MISSING:
                 return v
-        # A "local" location may live only in the nodelet's spill tier;
-        # pull_object restores it from disk (ref: restore_spilled_object).
-        for loc in sorted(locations, key=lambda a: tuple(a) != self.nodelet_addr):
+        # Local first (may only need a spill restore); REMOTE sources are
+        # shuffled so a fan-in of pullers spreads across every node that
+        # already holds a copy instead of hammering the producer — with
+        # copy registration below, a broadcast forms an emergent
+        # distribution tree (ref: object manager location updates let
+        # pulled copies serve later pulls).
+        local = [a for a in locations if tuple(a) == self.nodelet_addr]
+        remote = [a for a in locations if tuple(a) != self.nodelet_addr]
+        random.shuffle(remote)
+        for loc in local + remote:
             try:
                 r = self._run(self.pool.get(self.nodelet_addr).call(
                     "pull_object", oid=oid, source=tuple(loc), timeout=120.0))
@@ -570,10 +587,60 @@ class Runtime:
             if r.get("ok"):
                 v = self._read_local(oid)
                 if v is not _MISSING:
+                    if tuple(loc) != self.nodelet_addr:
+                        self._register_copy(oid, owner)
                     return v
+            elif tuple(loc) != self.nodelet_addr \
+                    and "not at source" in str(r.get("error", "")):
+                # definitively evicted there (NOT a transient source
+                # error or local store pressure) — have the owner drop
+                # the stale location (primaries are pinned and never
+                # pruned this way)
+                self._notify_drop_location(oid, tuple(loc), owner)
         # one more local attempt (producer may be co-located)
         v = self._read_local(oid)
         return v
+
+    def _fire_and_forget(self, to_addr: Address, op: str, **kw):
+        async def _send():
+            try:
+                await self.pool.get(tuple(to_addr)).call(op, timeout=10.0,
+                                                         **kw)
+            except Exception:
+                pass
+        self._spawn(_send())
+
+    def _register_copy(self, oid: ObjectID, owner: Optional[RuntimeAddress]):
+        """Tell the owner this node now holds a copy, so later pullers
+        can fetch from here (fire-and-forget)."""
+        if owner is None or owner.addr == self.address.addr:
+            self._add_location_locked(oid, tuple(self.nodelet_addr))
+            return
+        self._fire_and_forget(owner.addr, "add_location", oid=oid,
+                              addr=self.nodelet_addr)
+
+    def _add_location_locked(self, oid: ObjectID, addr: Address):
+        """Register only onto a live, ready entry (a freed/reset entry
+        must not be resurrected), under the directory lock — other
+        threads iterate e.locations (e.g. _locality_target)."""
+        with self._dir_lock:
+            e = self.directory.get(oid)
+            if e is not None and e.state == "ready":
+                e.locations.add(tuple(addr))
+
+    def _notify_drop_location(self, oid: ObjectID, addr: Address,
+                              owner: Optional[RuntimeAddress]):
+        if owner is None or owner.addr == self.address.addr:
+            self._drop_location_locked(oid, addr)
+            return
+        self._fire_and_forget(owner.addr, "drop_location", oid=oid,
+                              addr=addr)
+
+    def _drop_location_locked(self, oid: ObjectID, addr: Address):
+        with self._dir_lock:
+            e = self.directory.get(oid)
+            if e is not None and tuple(addr) not in e.primaries:
+                e.locations.discard(tuple(addr))
 
     def _reset_and_resubmit(self, spec: TaskSpec) -> bool:
         """Atomically flip the producing task's returns to pending and
@@ -589,6 +656,7 @@ class Runtime:
                 re_.state = "pending"
                 re_.inline = None
                 re_.locations = set()
+                re_.primaries = set()
                 re_.event.clear()
                 self.refs.register_owned(rid)
         self._submit_spec(spec, retries_left=spec.max_retries)
@@ -853,11 +921,13 @@ class Runtime:
         for oid in self._owned_ref_args(spec):
             with self._dir_lock:
                 e = self.directory.get(oid)
-            if e is None or e.state != "ready" or e.inline is not None:
-                continue
-            for loc in e.locations:
+                if e is None or e.state != "ready" or e.inline is not None:
+                    continue
+                locs = list(e.locations)  # snapshot: mutated by add_location
+                size = e.size
+            for loc in locs:
                 loc = tuple(loc)
-                scores[loc] = scores.get(loc, 0) + max(e.size, 1)
+                scores[loc] = scores.get(loc, 0) + max(size, 1)
         if not scores:
             return None
         return max(scores.items(), key=lambda kv: kv[1])[0]
@@ -982,9 +1052,11 @@ class Runtime:
             elif kind == "store":
                 if isinstance(payload, dict):
                     e.locations.add(tuple(payload["addr"]))
+                    e.primaries.add(tuple(payload["addr"]))
                     e.size = payload.get("size", 0)
                 else:
                     e.locations.add(tuple(payload))
+                    e.primaries.add(tuple(payload))
             elif kind == "err":
                 e.error = payload
                 e.state = "error"
@@ -1249,8 +1321,9 @@ class Runtime:
         v = self.memory_store.get_if_exists(oid)
         if v is not _MISSING and not isinstance(v, serialization.SerializedException):
             return {"status": "ready", "inline": serialization.pack(v)}
-        return {"status": "ready", "inline": None,
-                "locations": [list(a) for a in e.locations]}
+        with self._dir_lock:
+            locs = [list(a) for a in e.locations]
+        return {"status": "ready", "inline": None, "locations": locs}
 
     async def rpc_recover_object(self, oid: ObjectID,
                                  dead_locations=None) -> dict:
@@ -1274,6 +1347,7 @@ class Runtime:
                 for a in reported:
                     if a not in alive_addrs:
                         e.locations.discard(a)
+                        e.primaries.discard(a)
         if e.locations or e.inline is not None \
                 or self.memory_store.get_if_exists(oid) is not _MISSING:
             return {"status": "has_copies"}
@@ -1286,6 +1360,18 @@ class Runtime:
                            "(borrower-reported loss)", oid.hex()[:12])
             self._reset_and_resubmit(e.spec)
         return {"status": "reconstructing"}
+
+    async def rpc_add_location(self, oid: ObjectID, addr: Address) -> dict:
+        """A puller registered a secondary copy (emergent broadcast
+        tree); only meaningful while the object is live and ready."""
+        self._add_location_locked(oid, tuple(addr))
+        return {"ok": True}
+
+    async def rpc_drop_location(self, oid: ObjectID, addr: Address) -> dict:
+        """A puller found a registered secondary copy missing (LRU
+        eviction); primaries are pinned and never pruned this way."""
+        self._drop_location_locked(oid, tuple(addr))
+        return {"ok": True}
 
     async def rpc_locate(self, oid: ObjectID) -> dict:
         with self._dir_lock:

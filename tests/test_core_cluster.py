@@ -196,3 +196,42 @@ def test_locality_aware_lease_target(ray_start_cluster):
     (_, addr_a), (_, addr_b) = ray_tpu.get([a, b], timeout=60)
     assert addr_a == tuple(remote_node.addr)
     assert addr_b == driver_nodelet
+
+
+def test_broadcast_copies_register_and_spread(ray_start_cluster):
+    """Large-object fan-out (ref: release/benchmarks 1 GiB broadcast to
+    50+ nodes): pulled copies register with the owner so later pullers
+    spread across existing holders instead of hammering the producer."""
+    import numpy as np
+
+    from ray_tpu import _rt
+
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 1.0, "producer": 1.0})
+    for _ in range(3):
+        # consumers pinned off the producer node (locality targeting
+        # would otherwise pipeline every consumer onto the producer —
+        # zero-copy, but nothing to broadcast)
+        cluster.add_node(resources={"CPU": 2.0, "consumer": 2.0})
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"producer": 1})
+    def make_big():
+        return np.arange(600_000, dtype=np.float64)  # ~4.8 MB, store tier
+
+    @ray_tpu.remote(num_cpus=1, resources={"consumer": 1})
+    def consume(a):
+        return float(a[123]) + float(a[-1])
+
+    ref = make_big.remote()
+    ray_tpu.wait([ref], timeout=60)
+
+    out = ray_tpu.get([consume.remote(ref) for _ in range(9)], timeout=120)
+    assert out == [123.0 + 599_999.0] * 9
+
+    # the owner's directory now lists secondary copies beyond the
+    # producer's node (the emergent broadcast tree)
+    rt = _rt.get_runtime()
+    entry = rt.directory.get(ref.id)
+    assert entry is not None
+    assert len(entry.locations) >= 2, entry.locations
