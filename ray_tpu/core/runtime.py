@@ -1344,10 +1344,11 @@ class Runtime:
             except Exception:
                 alive_addrs = None  # GCS unreachable: don't prune
             if alive_addrs is not None:
-                for a in reported:
-                    if a not in alive_addrs:
-                        e.locations.discard(a)
-                        e.primaries.discard(a)
+                with self._dir_lock:
+                    for a in reported:
+                        if a not in alive_addrs:
+                            e.locations.discard(a)
+                            e.primaries.discard(a)
         if e.locations or e.inline is not None \
                 or self.memory_store.get_if_exists(oid) is not _MISSING:
             return {"status": "has_copies"}
@@ -1376,9 +1377,12 @@ class Runtime:
     async def rpc_locate(self, oid: ObjectID) -> dict:
         with self._dir_lock:
             e = self.directory.get(oid)
-        if e is None:
-            return {"status": "unknown"}
-        return {"status": e.state, "locations": [list(a) for a in e.locations]}
+            if e is None:
+                return {"status": "unknown"}
+            # snapshot under the lock: puller registrations mutate the set
+            # concurrently from executor threads
+            return {"status": e.state,
+                    "locations": [list(a) for a in e.locations]}
 
     async def rpc_add_borrow(self, oid: ObjectID, borrower_id: bytes) -> dict:
         self.refs.add_borrower(oid, borrower_id)

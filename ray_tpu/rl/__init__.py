@@ -15,10 +15,15 @@ the policy update is one jitted SPMD step on the TPU mesh.
 """
 
 from ray_tpu.rl.a2c import A2CConfig, A2CTrainer
+from ray_tpu.rl.appo import APPOConfig, APPOTrainer
+from ray_tpu.rl.bandit import (BanditConfig, LinearDiscreteBanditEnv,
+                               LinTSTrainer, LinUCBTrainer)
 from ray_tpu.rl.connectors import (ClipObs, Connector, ConnectorPipeline,
                                    FlattenObs, FrameStack, NormalizeObs)
 from ray_tpu.rl.core import Algorithm, ReplayActor, ReplayBuffer
+from ray_tpu.rl.ddpg import DDPGConfig, DDPGTrainer
 from ray_tpu.rl.dqn import DQNConfig, DQNTrainer
+from ray_tpu.rl.es import ARSConfig, ARSTrainer, ESConfig, ESTrainer
 from ray_tpu.rl.impala import ImpalaConfig, ImpalaTrainer
 from ray_tpu.rl.learner import Learner, LearnerGroup, LearnerSpec
 from ray_tpu.rl.multi_agent import (MultiAgentEnv, MultiAgentPPOConfig,
@@ -41,6 +46,12 @@ _REGISTRY = {
     "BC": (BCConfig, BCTrainer),
     "CQL": (CQLConfig, CQLTrainer),
     "MultiAgentPPO": (MultiAgentPPOConfig, MultiAgentPPOTrainer),
+    "APPO": (APPOConfig, APPOTrainer),
+    "DDPG": (DDPGConfig, DDPGTrainer),
+    "ES": (ESConfig, ESTrainer),
+    "ARS": (ARSConfig, ARSTrainer),
+    "BanditLinUCB": (BanditConfig, LinUCBTrainer),
+    "BanditLinTS": (BanditConfig, LinTSTrainer),
 }
 
 
@@ -66,4 +77,8 @@ __all__ = [
     "FlattenObs", "ClipObs",
     "PolicyServer", "PolicyClient", "ExternalPPOConfig",
     "ExternalPPOTrainer",
+    "APPOConfig", "APPOTrainer", "DDPGConfig", "DDPGTrainer",
+    "ESConfig", "ESTrainer", "ARSConfig", "ARSTrainer",
+    "BanditConfig", "LinUCBTrainer", "LinTSTrainer",
+    "LinearDiscreteBanditEnv",
 ]

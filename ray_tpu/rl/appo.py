@@ -1,0 +1,194 @@
+"""APPO: asynchronous PPO — IMPALA-style async sampling + clipped surrogate.
+
+Reference: rllib/algorithms/appo/ (APPO = PPO loss computed on V-trace
+corrected advantages over an asynchronous sample pipeline, plus a target
+network refreshed periodically to anchor the importance ratios —
+appo.py / appo_tf_policy.py). Workers sample with whatever weights they
+last received; the learner never blocks the fleet.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import Algorithm, probe_env_spec
+from ray_tpu.rl.ppo import RolloutWorker, init_policy, policy_forward
+
+
+@dataclass
+class APPOConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 100
+    batches_per_iter: int = 4
+    lr: float = 5e-4
+    gamma: float = 0.99
+    clip: float = 0.3
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    # refresh the ratio-anchoring target network every n learner updates
+    # (ref: appo.py target_update_frequency)
+    target_update_freq: int = 8
+    hidden: int = 64
+    seed: int = 0
+
+
+class APPOTrainer(Algorithm):
+    """Async PPO learner. One in-flight sample request per worker; each
+    landed fragment gets V-trace advantages (off-policy correction against
+    the *target* policy the fragment was sampled near) and one clipped
+    PPO update (ref: appo.py training_step)."""
+
+    def _setup(self, cfg: APPOConfig):
+        import jax
+        import optax
+
+        obs_dim, n_actions, _, _ = probe_env_spec(cfg.env, cfg.env_config)
+        assert n_actions is not None, "APPO zoo variant is discrete-action"
+        self.params = init_policy(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                  n_actions, cfg.hidden)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.workers = [
+            RolloutWorker.options(num_cpus=0.5).remote(
+                cfg.env, seed=cfg.seed + i * 1000,
+                env_config=cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        self._inflight: Dict[Any, Any] = {}
+        self.timesteps = 0
+        self.num_updates = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def vtrace(values, rewards, dones, rhos, last_value):
+            rho = jnp.minimum(rhos, cfg.vtrace_rho_clip)
+            c = jnp.minimum(rhos, cfg.vtrace_c_clip)
+            discounts = cfg.gamma * (1.0 - dones)
+            next_values = jnp.concatenate([values[1:], last_value[None]])
+            deltas = rho * (rewards + discounts * next_values - values)
+
+            def scan_fn(acc, t):
+                acc = deltas[t] + discounts[t] * c[t] * acc
+                return acc, acc
+
+            T = values.shape[0]
+            _, vs_minus_v = jax.lax.scan(scan_fn, jnp.zeros(()),
+                                         jnp.arange(T - 1, -1, -1))
+            vs = values + vs_minus_v[::-1]
+            next_vs = jnp.concatenate([vs[1:], last_value[None]])
+            pg_adv = rho * (rewards + discounts * next_vs - values)
+            return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+        def loss_fn(params, target, batch):
+            logits, values = policy_forward(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, batch["actions"][:, None],
+                                       -1)[:, 0]
+            # ratios anchored on the periodically-refreshed target net,
+            # not the behavior policy — the APPO stabilization trick
+            t_logits, _ = policy_forward(target, batch["obs"])
+            t_logp = jnp.take_along_axis(
+                jax.nn.log_softmax(t_logits), batch["actions"][:, None],
+                -1)[:, 0]
+            behav_rhos = jnp.exp(t_logp - batch["logp"])
+            vs, pg_adv = vtrace(values, batch["rewards"], batch["dones"],
+                                behav_rhos, batch["last_value"])
+            adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+            ratio = jnp.exp(logp - jax.lax.stop_gradient(t_logp))
+            pg = -jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv).mean()
+            vf = 0.5 * jnp.square(values - vs).mean()
+            ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+            return total, {"pg_loss": pg, "vf_loss": vf, "entropy": ent}
+
+        def update(params, target, opt_state, batch):
+            (total, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = total
+            return params, opt_state, aux
+
+        return update
+
+    def _launch(self, worker, params_host):
+        ref = worker.sample.remote(params_host,
+                                   self.config.rollout_fragment_length)
+        self._inflight[ref] = worker
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        params_host = jax.device_get(self.params)
+        for w in self.workers:
+            if w not in self._inflight.values():
+                self._launch(w, params_host)
+
+        aux = {}
+        consumed = 0
+        while consumed < cfg.batches_per_iter:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=60.0)
+            if not ready:
+                break
+            for ref in ready:
+                if consumed >= cfg.batches_per_iter:
+                    break
+                worker = self._inflight.pop(ref)
+                b = ray_tpu.get(ref)
+                batch = {
+                    "obs": jnp.asarray(b["obs"]),
+                    "actions": jnp.asarray(b["actions"]),
+                    "rewards": jnp.asarray(b["rewards"]),
+                    "dones": jnp.asarray(b["dones"], jnp.float32),
+                    "logp": jnp.asarray(b["logp"]),
+                    "last_value": jnp.asarray(b["last_value"]),
+                }
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.target, self.opt_state, batch)
+                self.timesteps += len(b["rewards"])
+                consumed += 1
+                self.num_updates += 1
+                if self.num_updates % cfg.target_update_freq == 0:
+                    self.target = jax.tree_util.tree_map(
+                        lambda x: x, self.params)
+                self._launch(worker, jax.device_get(self.params))
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "num_updates": self.num_updates,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "batches_consumed": consumed,
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        import jax
+
+        self.params = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, weights)

@@ -1,0 +1,158 @@
+"""DDPG: deep deterministic policy gradient (the TD3 base algorithm).
+
+Reference: rllib/algorithms/ddpg/ (ddpg.py — deterministic actor, single
+Q critic, polyak-averaged targets, Gaussian exploration; TD3 layers its
+three tricks on top of this, rllib td3.py). Shares the continuous-control
+rollout worker and net builders with ray_tpu.rl.td3.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import (Algorithm, ReplayBuffer, mlp_init,
+                             probe_env_spec)
+from ray_tpu.rl.td3 import _TD3Worker, policy_action, q_value
+
+
+def init_ddpg_nets(key, obs_dim: int, act_dim: int, hidden: int):
+    import jax
+
+    ks = jax.random.split(key, 2)
+    return {"actor": mlp_init(ks[0], [obs_dim, hidden, hidden, act_dim],
+                              out_scale=0.01),
+            "q": mlp_init(ks[1], [obs_dim + act_dim, hidden, hidden, 1])}
+
+
+@dataclass
+class DDPGConfig:
+    env: str = "Pendulum-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 1
+    rollout_fragment_length: int = 100
+    replay_capacity: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    updates_per_iter: int = 32
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    exploration_noise: float = 0.1
+    hidden: int = 128
+    seed: int = 0
+
+
+class DDPGTrainer(Algorithm):
+    """ref: rllib/algorithms/ddpg/ddpg.py — actor and critic updated every
+    step (no TD3 delay), single Q target, polyak on both nets."""
+
+    def _setup(self, cfg: DDPGConfig):
+        import jax
+        import optax
+
+        obs_dim, _n, act_dim, act_high = probe_env_spec(
+            cfg.env, cfg.env_config)
+        assert act_dim is not None, "DDPG needs a continuous action space"
+        self.act_high = act_high or 1.0
+        self.nets = init_ddpg_nets(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                   act_dim, cfg.hidden)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.nets)
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.actor_os = self.actor_opt.init(self.nets["actor"])
+        self.critic_os = self.critic_opt.init(self.nets["q"])
+        self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
+        self.workers = [
+            _TD3Worker.options(num_cpus=0.5).remote(
+                cfg.env, cfg.seed + i * 1000, cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self.num_updates = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        act_high = self.act_high
+
+        def update(nets, target, actor_os, critic_os, mb):
+            def critic_loss(q):
+                a_next = policy_action(target["actor"], mb["next_obs"],
+                                       act_high)
+                tq = q_value(target["q"], mb["next_obs"], a_next)
+                backup = jax.lax.stop_gradient(
+                    mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * tq)
+                return jnp.square(
+                    q_value(q, mb["obs"], mb["actions"]) - backup).mean()
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(nets["q"])
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os,
+                                                     nets["q"])
+            nets = {**nets, "q": optax.apply_updates(nets["q"], cupd)}
+
+            def actor_loss(actor):
+                a = policy_action(actor, mb["obs"], act_high)
+                return -q_value(nets["q"], mb["obs"], a).mean()
+
+            aloss, agrads = jax.value_and_grad(actor_loss)(nets["actor"])
+            aupd, actor_os = self.actor_opt.update(agrads, actor_os,
+                                                   nets["actor"])
+            nets = {**nets,
+                    "actor": optax.apply_updates(nets["actor"], aupd)}
+            target = jax.tree_util.tree_map(
+                lambda t, s: (1 - cfg.tau) * t + cfg.tau * s, target, nets)
+            return nets, target, actor_os, critic_os, {
+                "critic_loss": closs, "actor_loss": aloss}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        actor_host = jax.device_get(self.nets["actor"])
+        warmup = self.timesteps < cfg.learning_starts
+        refs = [w.sample.remote(actor_host, cfg.rollout_fragment_length,
+                                warmup, cfg.exploration_noise)
+                for w in self.workers]
+        for b in ray_tpu.get(refs):
+            self.buffer.add_batch(b)
+            self.timesteps += len(b["rewards"])
+
+        aux = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self.num_updates += 1
+                (self.nets, self.target, self.actor_os, self.critic_os,
+                 aux) = self._update(self.nets, self.target, self.actor_os,
+                                     self.critic_os, mb)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "num_updates": self.num_updates,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "buffer_size": len(self.buffer),
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, self.nets)

@@ -25,6 +25,7 @@ from ray_tpu.serve.api import (Application, Deployment, deployment,
                                get_deployment_handle, run, shutdown, start,
                                status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.graph import DAGDriverImpl, InputNode, build_app
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import Request, Response
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
@@ -33,5 +34,5 @@ __all__ = [
     "deployment", "run", "shutdown", "start", "status",
     "get_deployment_handle", "batch", "Deployment", "Application",
     "DeploymentHandle", "Request", "Response", "multiplexed",
-    "get_multiplexed_model_id",
+    "get_multiplexed_model_id", "build_app", "InputNode", "DAGDriverImpl",
 ]

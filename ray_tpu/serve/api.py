@@ -34,7 +34,25 @@ class Deployment:
                        self.max_concurrent_queries, self.user_config,
                        self.autoscaling_config, self.ray_actor_options,
                        args, kwargs)
-        return Application([d], d)
+        # Composition (ref: deployment_graph_build.py): nested bound
+        # deployments in the init args join this application's deployment
+        # list; serve.run turns them into handles at deploy time.
+        deps = [d]
+        seen = {d.name: d}
+        for v in _flatten_values(args, kwargs):
+            if isinstance(v, Application):
+                for child in v.deployments:
+                    prev = seen.get(child.name)
+                    if prev is None:
+                        seen[child.name] = child
+                        deps.append(child)
+                    elif prev is not child:
+                        raise ValueError(
+                            f"two distinct bound deployments share the "
+                            f"name {child.name!r}; give one a "
+                            ".options(name=...) — merging would route "
+                            "both handles to whichever deployed first")
+        return Application(deps, d)
 
     def options(self, **kw) -> "Deployment":
         d = Deployment(self.func_or_class, kw.pop("name", self.name),
@@ -49,10 +67,48 @@ class Deployment:
         return d
 
 
+def _flatten_values(args, kwargs):
+    out = []
+
+    def scan(v):
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                scan(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                scan(x)
+        else:
+            out.append(v)
+
+    for a in args:
+        scan(a)
+    for a in kwargs.values():
+        scan(a)
+    return out
+
+
 @dataclass
 class Application:
     deployments: List[Deployment]
     ingress: Deployment
+
+    def __getattr__(self, name: str):
+        # graph authoring: `app.method.bind(...)` builds a
+        # DeploymentMethodNode (ref: serve deployment graph DAG idiom)
+        if (name.startswith("_") and name != "__call__") \
+                or name in ("deployments", "ingress"):
+            raise AttributeError(name)
+        # only resolve methods the bound class actually defines — typos
+        # and duck-type probes (hasattr(app, "keys")) must fail here, not
+        # at request time inside the DAGDriver
+        target = self.ingress.func_or_class
+        if not hasattr(target, name):
+            raise AttributeError(
+                f"{getattr(target, '__name__', target)!r} has no method "
+                f"{name!r} to bind")
+        from ray_tpu.serve.graph import _GraphMethod
+
+        return _GraphMethod(self, name)
 
 
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
@@ -84,13 +140,32 @@ def _get_or_start_controller():
             return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
 
 
+def _handleize(v):
+    """Replace nested bound deployments with runtime handles (ref:
+    deployment_graph_build.py — DeploymentNodes become handles in the
+    parent's init args)."""
+    if isinstance(v, Application):
+        return DeploymentHandle(v.ingress.name)
+    if isinstance(v, tuple):
+        return tuple(_handleize(x) for x in v)
+    if isinstance(v, list):
+        return [_handleize(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _handleize(x) for k, x in v.items()}
+    return v
+
+
 def run(app: Application, *, route_prefix: Optional[str] = None,
         _blocking: bool = False) -> DeploymentHandle:
     """Deploy every deployment in the app; returns the ingress handle
     (ref: serve.run api.py:414). route_prefix registers the ingress with
     the HTTP proxy's route table."""
     controller = _get_or_start_controller()
-    for d in app.deployments:
+    # children first (bind() appends them after the parent): a parent that
+    # warms up through an injected handle in __init__ must find the child's
+    # replicas already deployed (ref: topological deploy order in
+    # deployment_graph_build.py)
+    for d in reversed(app.deployments):
         from ray_tpu.core.runtime import _dumps_function
 
         blob = _dumps_function(d.func_or_class) \
@@ -103,7 +178,8 @@ def run(app: Application, *, route_prefix: Optional[str] = None,
             "ray_actor_options": d.ray_actor_options,
         }
         ray_tpu.get(controller.deploy.remote(
-            d.name, blob, d.init_args, d.init_kwargs, config))
+            d.name, blob, _handleize(d.init_args), _handleize(d.init_kwargs),
+            config))
     if route_prefix is not None:
         ray_tpu.get(controller.set_route.remote(route_prefix,
                                                 app.ingress.name))

@@ -43,12 +43,20 @@ class Replica:
                 from ray_tpu.serve.multiplex import _set_multiplexed_model_id
 
                 _set_multiplexed_model_id(context["multiplexed_model_id"])
-            fn = getattr(self.instance, method)
-            out = fn(*args, **kwargs)
             import asyncio
+            import inspect
 
-            if asyncio.iscoroutine(out):
-                out = await out
+            fn = getattr(self.instance, method)
+            if inspect.iscoroutinefunction(fn):
+                out = await fn(*args, **kwargs)
+            else:
+                # Sync handlers run on an executor thread (ref:
+                # _private/replica.py runs sync callables off the event
+                # loop) so they may issue blocking runtime calls — e.g.
+                # a composed deployment ray_tpu.get()-ing a child handle.
+                out = await asyncio.to_thread(fn, *args, **kwargs)
+                if asyncio.iscoroutine(out):
+                    out = await out
             return out
         finally:
             self.inflight -= 1

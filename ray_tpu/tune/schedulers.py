@@ -47,16 +47,27 @@ class ASHAScheduler:
         if t >= self.max_t:
             return STOP
         val = float(metric) if self.mode == "max" else -float(metric)
-        decision = CONTINUE
-        for ms in self.milestones:
-            if t >= ms and trial_id not in self.rungs[ms]:
-                self.rungs[ms][trial_id] = val
-                peers = sorted(self.rungs[ms].values(), reverse=True)
-                k = max(1, len(peers) // self.rf)
-                cutoff = peers[k - 1]
-                if val < cutoff and len(peers) >= self.rf:
-                    decision = STOP
-        return decision
+        # Re-check on EVERY report against the highest crossed milestone
+        # (ref: async_hyperband.py _Bracket.on_result) — a trial that was
+        # first to record at a rung must still be halted once
+        # later-arriving peers push the cutoff above it; checking only at
+        # the first crossing lets a leading loser run to max_t.
+        for ms in reversed(self.milestones):
+            if t < ms:
+                continue
+            rung = self.rungs[ms]
+            # record once, at the milestone crossing — overwriting with
+            # later (bigger-budget) values would make rung comparisons
+            # budget-unfair to trials arriving at the milestone on time
+            if trial_id not in rung:
+                rung[trial_id] = val
+            peers = sorted(rung.values(), reverse=True)
+            k = max(1, len(peers) // self.rf)
+            cutoff = peers[k - 1]
+            if len(peers) >= self.rf and rung[trial_id] < cutoff:
+                return STOP
+            break  # only the top crossed rung gates continuation
+        return CONTINUE
 
 
 class MedianStoppingRule:
