@@ -127,6 +127,18 @@ def cmd_debug(args):
     rpdb.attach(s["host"], s["port"], token=s.get("token", ""))
 
 
+def cmd_microbenchmark(args):
+    from ray_tpu._perf import main as perf_main
+
+    argv = []
+    if args.address:
+        argv += ["--address", args.address]
+    for f in args.filter or []:
+        argv += ["--filter", f]
+    argv += ["--min-seconds", str(args.min_seconds)]
+    perf_main(argv)
+
+
 def cmd_gateway(args):
     """Serve remote drivers (ref: ray client server / proxier)."""
     import asyncio
@@ -240,6 +252,14 @@ def main():
 
     s = sub.add_parser("stop", help="stop head daemons")
     s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("microbenchmark",
+                       help="core task/actor/object throughput suite "
+                            "(ref: ray microbenchmark)")
+    s.add_argument("--address", default=None)
+    s.add_argument("--filter", action="append", default=None)
+    s.add_argument("--min-seconds", type=float, default=2.0)
+    s.set_defaults(fn=cmd_microbenchmark)
 
     for name, fn in [("status", cmd_status), ("summary", cmd_summary),
                      ("memory", cmd_memory), ("metrics", cmd_metrics),

@@ -523,9 +523,11 @@ class Nodelet:
     async def _grant(self, resources: ResourceSet, pg: Optional[Tuple],
                      job_id: Optional[bytes] = None,
                      retriable: bool = True,
-                     env_vars: Optional[dict] = None) -> dict:
+                     env_vars: Optional[dict] = None,
+                     reserved: bool = False) -> dict:
         pool = self._resource_pool(pg)
-        pool.subtract(resources)
+        if not reserved:
+            pool.subtract(resources)
         w = await self._pop_worker(env_vars)
         if w is None:
             pool.add(resources)
@@ -574,11 +576,21 @@ class Nodelet:
             if p.fut.done():
                 continue
             if pool is not None and p.resources.fits_in(pool):
+                # Reserve SYNCHRONOUSLY: the grant runs as a task, and
+                # deferring the subtract would admit every pending lease
+                # against the same un-decremented pool (one freed CPU
+                # must grant one lease, not the whole queue).
+                pool.subtract(p.resources)
+
                 async def _do(p=p):
                     r = await self._grant(p.resources, p.pg, p.job_id,
-                                          p.retriable, p.env_vars)
+                                          p.retriable, p.env_vars,
+                                          reserved=True)
                     if not p.fut.done():
                         p.fut.set_result(r)
+                    elif r.get("status") == "granted":
+                        # requester gave up (timeout): hand the lease back
+                        self._release_lease(r["lease_id"])
                 loop.create_task(_do())
             else:
                 still.append(p)

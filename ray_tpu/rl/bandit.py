@@ -79,15 +79,21 @@ class _BanditBase:
         self.config = config
         env = config.env
         if env is None:
-            env = LinearDiscreteBanditEnv(
-                config.num_arms, config.context_dim, seed=config.seed,
-                **config.env_config)
+            kw = {"num_arms": config.num_arms,
+                  "context_dim": config.context_dim, "seed": config.seed}
+            kw.update(config.env_config)   # env_config wins, no dup kwarg
+            env = LinearDiscreteBanditEnv(**kw)
         elif callable(env):
             env = env(config.env_config)
         self.env = env
+        # size the arm set from the ENV when it says (a custom env's arm
+        # count must win over the config default, else arms go unplayed)
+        self.num_arms = int(getattr(env, "num_arms", config.num_arms))
+        self.context_dim = int(getattr(env, "context_dim",
+                                       config.context_dim))
         self.arms = [
-            _LinearArm(config.context_dim, config.ridge_lambda)
-            for _ in range(config.num_arms)]
+            _LinearArm(self.context_dim, config.ridge_lambda)
+            for _ in range(self.num_arms)]
         self.rng = np.random.default_rng(config.seed)
         self.iteration = 0
         self.timesteps = 0

@@ -123,14 +123,9 @@ class DAGDriverImpl:
     (ref: drivers.py DAGDriver.predict / __call__)."""
 
     def __init__(self, spec: dict, http_adapter=None):
-        from concurrent.futures import ThreadPoolExecutor
-
         self.spec = spec
         self.http_adapter = http_adapter
         self._handles: Dict[str, DeploymentHandle] = {}
-        # shared fan-out pool (one per replica, not per request); _fan
-        # keeps one sibling inline per level so nesting can't starve it
-        self._pool = ThreadPoolExecutor(max_workers=32)
 
     def _handle(self, name: str) -> DeploymentHandle:
         if name not in self._handles:
@@ -198,17 +193,38 @@ class DAGDriverImpl:
 
     def _fan(self, specs, request, memo: dict):
         """Evaluate sibling subtrees concurrently so independent branches
-        of a diamond overlap (each branch blocks on its own gets). The
-        LAST sibling runs inline on the current thread, so nesting depth
-        never starves the shared pool."""
-        branching = [s for s in specs
-                     if s["type"] in ("call", "list", "tuple", "dict")]
-        if len(branching) < 2:
+        of a diamond overlap (each branch blocks on its own gets). One
+        BRANCHING sibling runs inline; the others get dedicated threads —
+        a bounded shared pool here can deadlock (threads blocked in
+        result() on children that queue behind them) at depth under
+        load, and thread spawn is cheap next to a handle round-trip."""
+        import threading
+
+        branch_idx = [i for i, s in enumerate(specs)
+                      if s["type"] in ("call", "list", "tuple", "dict")]
+        if len(branch_idx) < 2:
             return [self._run(s, request, memo) for s in specs]
-        futs = [self._pool.submit(self._run, s, request, memo)
-                for s in specs[:-1]]
-        last = self._run(specs[-1], request, memo)
-        return [f.result() for f in futs] + [last]
+        inline_i = branch_idx[-1]
+        out: list = [None] * len(specs)
+        errs: list = []
+        threads = []
+        for i in branch_idx[:-1]:
+            def work(i=i):
+                try:
+                    out[i] = self._run(specs[i], request, memo)
+                except BaseException as e:  # re-raised on the caller
+                    errs.append(e)
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            threads.append(t)
+        for i, s in enumerate(specs):
+            if i == inline_i or i not in branch_idx:
+                out[i] = self._run(s, request, memo)
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return out
 
     def predict(self, request):
         import threading
@@ -232,12 +248,17 @@ def build_app(root: DeploymentMethodNode, *, name: str = "DAGDriver",
                         num_replicas=num_replicas)
     driver_app = driver.bind(spec, http_adapter)
     merged: List[Deployment] = list(driver_app.deployments)
-    seen = {d.name for d in merged}
+    seen: Dict[str, Deployment] = {d.name: d for d in merged}
     for app in apps.values():
         for d in app.deployments:
-            if d.name not in seen:
-                seen.add(d.name)
+            prev = seen.get(d.name)
+            if prev is None:
+                seen[d.name] = d
                 merged.append(d)
+            elif prev is not d:
+                raise ValueError(
+                    f"two distinct bound deployments share the name "
+                    f"{d.name!r}; give one a .options(name=...)")
     return Application(merged, driver_app.ingress)
 
 

@@ -29,6 +29,8 @@ from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.trainer import JaxTrainer, Result, TorchTrainer
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
+from ray_tpu.train.sklearn import (LightGBMTrainer, SklearnTrainer,
+                                   XGBoostTrainer)
 from ray_tpu.train import session
 
 __all__ = [
@@ -37,4 +39,5 @@ __all__ = [
     "Predictor", "JaxPredictor", "BatchPredictor",
     "Backend", "JaxBackend", "TorchBackend", "prepare_model",
     "prepare_data_loader",
+    "SklearnTrainer", "XGBoostTrainer", "LightGBMTrainer",
 ]

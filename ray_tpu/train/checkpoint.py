@@ -33,6 +33,11 @@ class Checkpoint:
         return cls(d)
 
     @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        """ref: air/checkpoint.py Checkpoint.from_directory."""
+        return cls(path)
+
+    @classmethod
     def from_state(cls, state: Any, path: str) -> "Checkpoint":
         """Save a jax pytree (TrainState, params, ...) with orbax."""
         os.makedirs(path, exist_ok=True)
@@ -53,6 +58,11 @@ class Checkpoint:
     def load_state(self) -> Any:
         with open(os.path.join(self.path, "state.pkl"), "rb") as f:
             return pickle.load(f)
+
+    def to_directory(self) -> str:
+        """ref: air/checkpoint.py Checkpoint.to_directory — a Checkpoint
+        IS a directory here, so this is the identity accessor."""
+        return self.path
 
     def exists(self) -> bool:
         return os.path.isdir(self.path) and bool(os.listdir(self.path))

@@ -136,3 +136,30 @@ def test_get_timeout(ray_start_regular):
 def test_cluster_resources(ray_start_regular):
     total = ray_tpu.cluster_resources()
     assert total.get("CPU") == 4.0
+
+
+def test_resource_accounting_no_leak_under_churn(ray_start_regular):
+    """Pending-lease drain must reserve synchronously: one freed CPU
+    admits one queued lease, not the whole queue (regression: available
+    CPU went negative by ~100 under batch churn and the worker pool
+    exploded)."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    t0 = time.time()
+    while time.time() - t0 < 1.5:
+        ray_tpu.get([noop.remote() for _ in range(80)])
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) >= 0, avail
+    # leases drain back to the full node shortly after the churn stops
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU") == 4.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU") == 4.0

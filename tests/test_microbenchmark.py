@@ -1,0 +1,15 @@
+"""Microbenchmark harness smoke (ref: ray_perf.py is exercised in CI via
+short runs; correctness here, numbers at release time)."""
+
+
+def test_microbenchmark_runs(ray_start_regular):
+    from ray_tpu._perf import run_microbenchmarks
+
+    res = run_microbenchmarks(
+        which=["task_single", "put_small", "actor"], min_seconds=0.3)
+    names = {r["name"] for r in res}
+    assert "task_roundtrip" in names
+    assert "put_small_100B" in names
+    assert "actor_call_roundtrip" in names
+    for r in res:
+        assert r["ops_per_s"] > 0
