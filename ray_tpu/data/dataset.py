@@ -208,6 +208,10 @@ class Dataset:
             batch_size=batch_size, drop_last=drop_last,
             local_shuffle_seed=local_shuffle_seed)
 
+    def iter_torch_batches(self, **kw):
+        return DataIterator(self._block_refs, self._ops).iter_torch_batches(
+            **kw)
+
     def iter_rows(self) -> Iterator[Any]:
         for b in self._iter_blocks():
             yield from (_rows_of(b) if isinstance(b, dict) else b)
@@ -739,6 +743,33 @@ class DataIterator:
                 yield batch
         if rows_in_buf and not drop_last:
             yield _block_concat(buf)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           local_shuffle_seed: Optional[int] = None,
+                           dtypes=None, device=None):
+        """Batches as torch tensors (ref: iterator.py iter_torch_batches —
+        the reference's torch-ingest path; torch-cpu is in the TPU image
+        for migration workloads). Tabular blocks become {col: tensor};
+        list blocks become a tensor when rows are numeric."""
+        import torch
+
+        def to_t(v, col=None):
+            t = torch.as_tensor(np.asarray(v))
+            dt = dtypes.get(col) if isinstance(dtypes, dict) else dtypes
+            if dt is not None:
+                t = t.to(dt)
+            if device is not None:
+                t = t.to(device)
+            return t
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last,
+                                       local_shuffle_seed=local_shuffle_seed):
+            if isinstance(batch, dict):
+                yield {k: to_t(v, k) for k, v in batch.items()}
+            else:
+                yield to_t(batch)
 
     def iter_device_batches(self, *, batch_size: int, sharding=None,
                             drop_last: bool = True):

@@ -180,6 +180,10 @@ def _attention(q, k, v, cfg: LlamaConfig, causal=True, q_offset=0):
         from ray_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, axis_name="sp")
+    if cfg.attn_impl == "ulysses":
+        from ray_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, axis_name="sp")
     return _attention_xla(q, k, v, causal, q_offset)
 
 
@@ -250,13 +254,17 @@ def forward(params, tokens, cfg: LlamaConfig, pos_offset=0):
 
 
 def forward_sp(params, tokens, cfg: LlamaConfig, mesh):
-    """Sequence-parallel forward: seq sharded over the 'sp' mesh axis, ring
-    attention exchanging KV around the ICI ring (ops/ring_attention.py).
-    Partial-manual shard_map: only 'sp' is manual; dp/fsdp/tp stay under
-    GSPMD so the same params shardings apply unchanged."""
+    """Sequence-parallel forward: seq sharded over the 'sp' mesh axis.
+    Two interchangeable exchanges (SURVEY.md §5.7): ring attention (KV
+    rotates around the ICI ring, ops/ring_attention.py) or Ulysses
+    (head-scatter all-to-all, ops/ulysses.py) — set cfg.attn_impl to
+    "ring" or "ulysses". Partial-manual shard_map: only 'sp' is manual;
+    dp/fsdp/tp stay under GSPMD so the same params shardings apply
+    unchanged."""
     from jax.sharding import PartitionSpec as P
 
-    cfg_ring = cfg.replace(attn_impl="ring")
+    cfg_ring = cfg if cfg.attn_impl == "ulysses" \
+        else cfg.replace(attn_impl="ring")
     sp = int(mesh.shape["sp"])
 
     def fwd_local(params, tok_local):
@@ -313,7 +321,7 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     else:
         inputs, targets = batch["inputs"], batch["targets"]
         mask = batch.get("mask")
-    if (cfg.attn_impl == "ring" and mesh is not None
+    if (cfg.attn_impl in ("ring", "ulysses") and mesh is not None
             and int(mesh.shape.get("sp", 1)) > 1):
         logits = forward_sp(params, inputs, cfg, mesh)
     elif mesh is not None and int(mesh.shape.get("pp", 1)) > 1:

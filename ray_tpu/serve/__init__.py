@@ -26,6 +26,8 @@ from ray_tpu.serve.api import (Application, Deployment, deployment,
                                status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.graph import DAGDriverImpl, InputNode, build_app
+from ray_tpu.serve.grpc_proxy import (GrpcServeClient, shutdown_grpc,
+                                      start_grpc)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import Request, Response
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
@@ -35,4 +37,5 @@ __all__ = [
     "get_deployment_handle", "batch", "Deployment", "Application",
     "DeploymentHandle", "Request", "Response", "multiplexed",
     "get_multiplexed_model_id", "build_app", "InputNode", "DAGDriverImpl",
+    "start_grpc", "shutdown_grpc", "GrpcServeClient",
 ]

@@ -482,3 +482,24 @@ def test_webdataset_reader(ray_start_regular, tmp_path):
     assert rows[1]["txt"] == "caption 1"
     assert rows[2]["json"] == {"idx": 2}
     assert rows[0]["bin"] == b"\x00\x01"
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import torch
+
+    from ray_tpu import data
+
+    ds = data.range(100)
+    seen = 0
+    for b in ds.iter_torch_batches(batch_size=32):
+        assert isinstance(b["id"], torch.Tensor)
+        seen += len(b["id"])
+    assert seen == 100
+    # dtype + list-block path
+    ds2 = data.from_items([float(i) for i in range(10)], num_blocks=2)
+    b = next(ds2.iter_torch_batches(batch_size=10, dtypes=torch.float32))
+    assert b.dtype == torch.float32 and b.shape == (10,)
+    # per-column dtypes dict (ref iterator.py API shape)
+    b = next(ds.iter_torch_batches(batch_size=8,
+                                   dtypes={"id": torch.float64}))
+    assert b["id"].dtype == torch.float64

@@ -1,0 +1,35 @@
+"""Serve gRPC ingress: generic Predict contract end-to-end.
+
+Reference test model: serve gRPC driver tests — deploy, call over a real
+gRPC channel, assert results and error surfacing.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.grpc_proxy import (GrpcServeClient, shutdown_grpc,
+                                      start_grpc)
+
+
+def test_grpc_predict_roundtrip(ray_start_regular):
+    @serve.deployment(ray_actor_options={"num_cpus": 0.1})
+    class Adder:
+        def __call__(self, x, y=0):
+            return x + y
+
+        def tenfold(self, x):
+            return x * 10
+
+    serve.run(Adder.bind())
+    port = start_grpc()
+    client = GrpcServeClient(f"127.0.0.1:{port}")
+    try:
+        assert client.predict("Adder", 2, y=3) == 5
+        assert client.predict("Adder", 7, method="tenfold") == 70
+        with pytest.raises(RuntimeError, match="TypeError"):
+            client.predict("Adder", 1, 2, 3)   # bad signature surfaces
+    finally:
+        client.close()
+        shutdown_grpc()
+        serve.shutdown()
