@@ -25,6 +25,9 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     object_transfer_chunk_bytes: int = 8 * 1024**2  # ref: 64MiB gRPC chunks; we
                                                     # default smaller for 1-host
+    # Native zero-staging transfer plane (native/xfer.cc); off -> always
+    # use the portable chunk-RPC pull path.
+    native_transfer_enabled: bool = True
     # --- object spilling (ref: local_object_manager.h:41 + external_storage) -
     object_spill_enabled: bool = True
     object_spill_threshold: float = 0.8          # spill when usage crosses this

@@ -113,6 +113,10 @@ class Nodelet:
         self.primary_pins: set = set()
         self._spilled_then_dropped = 0
         self._restored = 0
+        self._native_pulls = 0
+        self.xfer_port = -1
+        # source addr -> (xfer port or -1, cache expiry time)
+        self._xfer_ports: Dict[Tuple, Tuple[int, float]] = {}
         self._hb_seq = 0
         self._stopping = False
         self.memory_monitor = MemoryMonitor(
@@ -124,6 +128,11 @@ class Nodelet:
         self.store = SharedMemoryStore(
             self.store_name, capacity=self.cfg.object_store_memory,
             max_objects=self.cfg.object_store_max_objects, create=True)
+        # Native transfer plane (xfer.cc): shm->socket zero-staging path
+        # for inter-node pulls; -1 (disabled or failed to start) falls
+        # back to the chunk RPC path transparently.
+        self.xfer_port = self.store.xfer_serve_start(host) \
+            if self.cfg.native_transfer_enabled else -1
         self.server.host, self.server.port = host, port
         addr = await self.server.start()
         info = NodeInfo(node_id=self.node_id, nodelet_addr=addr,
@@ -781,8 +790,72 @@ class Nodelet:
             self.store.release(oid)
         return {"total": total, "data": data}
 
+    async def rpc_xfer_addr(self) -> dict:
+        """The native transfer plane's endpoint (xfer.cc), or port -1 if
+        it did not start (pullers then use the chunk RPC path)."""
+        return {"host": self.server.host, "port": self.xfer_port}
+
+    async def _xfer_port_for(self, key: Tuple) -> int:
+        """Cached peer xfer port. Failures are cached only briefly (a
+        peer busy at startup must not disable the native plane forever)
+        and successes expire too (a restarted peer binds a new port)."""
+        cached = self._xfer_ports.get(key)
+        now = time.time()
+        if cached is not None and now < cached[1]:
+            return cached[0]
+        try:
+            r = await self.pool.get(key).call("xfer_addr", timeout=10.0)
+            port = int(r["port"])
+            ttl = 300.0
+        except (ConnectionLost, RemoteError, OSError, KeyError):
+            port, ttl = -1, 15.0
+        self._xfer_ports[key] = (port, now + ttl)
+        return port
+
+    async def _pull_native(self, oid: ObjectID, source: Address) -> bool:
+        """Try the zero-staging native plane first. Returns True when the
+        object is sealed locally; False = fall back to chunk RPC."""
+        key = tuple(source)
+        port = await self._xfer_port_for(key)
+        if port <= 0:
+            return False
+        host = source[0]
+        rc = await asyncio.to_thread(self.store.xfer_fetch, host, port, oid)
+        if rc == 3 and self.spill is not None:
+            # allocation failed: free space (spill-before-evict) and retry
+            await self._spill_pass(self.cfg.object_store_memory // 4)
+            rc = await asyncio.to_thread(self.store.xfer_fetch, host, port,
+                                         oid)
+        if rc == 5:
+            # a racing pull/producer owns the buffer: wait for its seal
+            # instead of transferring a second copy
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                if self.store.contains(oid):
+                    return True
+                st = self.store.state(oid)
+                if st == 0:   # racer aborted; retry once natively
+                    rc2 = await asyncio.to_thread(self.store.xfer_fetch,
+                                                  host, port, oid)
+                    if rc2 == 0:
+                        self._native_pulls += 1
+                        return True
+                    if rc2 != 5:
+                        return False
+                await asyncio.sleep(0.02)
+            return False
+        if rc == 2:
+            # io error: peer may have restarted on a new port — requery
+            self._xfer_ports.pop(key, None)
+            return False
+        if rc == 0:
+            self._native_pulls += 1
+            return True
+        return False
+
     async def rpc_pull_object(self, oid: ObjectID, source: Address) -> dict:
-        """Pull a remote object into the local store, chunked
+        """Pull a remote object into the local store: native zero-staging
+        plane (xfer.cc) when the source runs one, chunked RPC otherwise
         (ref: PullManager pull_manager.h:52 + ObjectManager::Push)."""
         if self.store.contains(oid):
             return {"ok": True}
@@ -790,6 +863,8 @@ class Nodelet:
             return {"ok": True}
         if tuple(source) == (self.server.host, self.server.port):
             return {"ok": False, "error": "object not at source"}
+        if await self._pull_native(oid, source):
+            return {"ok": True}
         src = self.pool.get(tuple(source))
         chunk = self.cfg.object_transfer_chunk_bytes
         try:
@@ -857,6 +932,8 @@ class Nodelet:
             "spilled_bytes": (self.spill.bytes_spilled()
                               if self.spill is not None else 0),
             "restored_objects": self._restored,
+            "native_pulls": self._native_pulls,
+            "xfer_port": self.xfer_port,
             "pending_leases": len(self.pending),
             "oom_kills": self.memory_monitor.kills,
         }
@@ -869,7 +946,10 @@ class Nodelet:
         for w in list(self.workers.values()):
             self._kill_worker(w, "nodelet shutdown")
         if self.store is not None:
-            self.store.close(destroy=True)
+            self.store.xfer_serve_stop()
+            # keep the segment mapped until os._exit: a live xfer thread
+            # mid-transfer must fault on a closed socket, not on munmap
+            self.store.close(destroy=True, unmap=False)
         asyncio.get_running_loop().call_later(0.05, lambda: os._exit(0))
         return {"ok": True}
 

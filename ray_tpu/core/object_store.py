@@ -75,6 +75,17 @@ class _Lib:
                 lib.ts_evict.restype = ctypes.c_int
                 lib.ts_evict.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                          ctypes.c_int64]
+                lib.ts_state.restype = ctypes.c_int
+                lib.ts_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.ts_xfer_serve_start.restype = ctypes.c_int
+                lib.ts_xfer_serve_start.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+                lib.ts_xfer_serve_stop.restype = None
+                lib.ts_xfer_serve_stop.argtypes = []
+                lib.ts_xfer_fetch.restype = ctypes.c_int
+                lib.ts_xfer_fetch.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                    ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
                 cls._lib = lib
             return cls._lib
 
@@ -156,6 +167,12 @@ class SharedMemoryStore:
             return False
         return bool(self._lib.ts_contains(self._h, oid.binary()))
 
+    def state(self, oid: ObjectID) -> int:
+        """0 = absent, 1 = creating (mid-write), 2 = sealed."""
+        if not self._h:
+            return 0
+        return int(self._lib.ts_state(self._h, oid.binary()))
+
     def delete(self, oid: ObjectID) -> None:
         if not self._h:
             return
@@ -225,6 +242,25 @@ class SharedMemoryStore:
 
     # -- stats ---------------------------------------------------------------
 
+    # ---- native transfer plane (xfer.cc) -----------------------------------
+
+    def xfer_serve_start(self, host: str = "127.0.0.1") -> int:
+        """Start the zero-staging TCP transfer server; returns the bound
+        port (-1 if it could not start — callers fall back to the chunk
+        RPC path)."""
+        return int(self._lib.ts_xfer_serve_start(self._h, host.encode(), 0))
+
+    def xfer_serve_stop(self) -> None:
+        self._lib.ts_xfer_serve_stop()
+
+    def xfer_fetch(self, host: str, port: int, oid: ObjectID) -> int:
+        """Blocking fetch of one remote object straight into this store.
+        0=ok 1=absent-at-source 2=io-error 3=alloc-failed 4=protocol."""
+        total = ctypes.c_uint64(0)
+        return int(self._lib.ts_xfer_fetch(
+            self._h, host.encode(), port, oid.binary(),
+            ctypes.byref(total)))
+
     def bytes_in_use(self) -> int:
         return self._lib.ts_bytes_in_use(self._h)
 
@@ -237,8 +273,12 @@ class SharedMemoryStore:
     def num_evictions(self) -> int:
         return self._lib.ts_num_evictions(self._h)
 
-    def close(self, destroy: bool = False) -> None:
-        if self._h:
+    def close(self, destroy: bool = False, unmap: bool = True) -> None:
+        """unmap=False unlinks the shm name without munmapping — the path
+        for process exit while native transfer threads may still touch
+        the segment (the mapping dies with the process; munmapping under
+        a live xfer.cc thread would SIGSEGV it mid-transfer)."""
+        if self._h and unmap:
             try:
                 self._view.release()
                 self._mm.close()

@@ -107,8 +107,23 @@ class Dataset:
 
     # ---- transformations (lazy) -------------------------------------------
 
-    def map_batches(self, fn: Callable[[Block], Block]) -> "Dataset":
-        return Dataset(self._block_refs, self._ops + [("map_batches", fn)])
+    def map_batches(self, fn: Callable[[Block], Block], *,
+                    batch_size: Optional[int] = None) -> "Dataset":
+        """batch_size re-slices each block before fn (ref: dataset.py:385
+        map_batches(batch_size=...) — bounds the UDF's working set, e.g.
+        a model's device batch)."""
+        if batch_size is None:
+            return Dataset(self._block_refs,
+                           self._ops + [("map_batches", fn)])
+
+        def rebatched(block):
+            n = _block_rows(block)
+            outs = [fn(_block_slice(block, lo, min(lo + batch_size, n)))
+                    for lo in builtins.range(0, n, batch_size)]
+            return _block_concat(outs)
+
+        return Dataset(self._block_refs,
+                       self._ops + [("map_batches", rebatched)])
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         return Dataset(self._block_refs, self._ops + [("map", fn)])

@@ -1,0 +1,171 @@
+"""ViT family: patch-embedded image classification transformer.
+
+The vision model family for the batch-inference and Train paths
+(BASELINE.md's torch/tf train benchmarks use image classifiers:
+release/air_tests/air_benchmarks/workloads/torch_benchmark.py trains on
+images — this is the TPU-native equivalent family). Same functional
+conventions as llama.py/gpt2.py: init_params/forward/loss_fn/param_specs
+over a scanned layer stack.
+
+TPU notes: patch embedding is a reshape+matmul (not a conv) so the MXU
+sees one large GEMM; attention is non-causal full attention over
+patches+cls; bf16 activations with f32 params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.gpt2 import layer_norm
+from ray_tpu.models.llama import _attention_xla
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self):
+        return self.patch_size * self.patch_size * self.channels
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+PRESETS: Dict[str, ViTConfig] = {
+    "tiny": ViTConfig(image_size=32, patch_size=8, num_classes=10,
+                      d_model=64, n_layers=2, n_heads=4, d_ff=128),
+    "base": ViTConfig(),                                     # ViT-B/16
+    "large": ViTConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096),
+    "huge": ViTConfig(patch_size=14, d_model=1280, n_layers=32,
+                      n_heads=16, d_ff=5120),
+}
+
+
+def param_specs(cfg: ViTConfig) -> Dict[str, Any]:
+    """Sharding specs per parallel/sharding.py axis names (embed/heads/mlp
+    shardable; biases and norms replicated)."""
+    L = ("layers",)
+    return {
+        "patch_w": (None, "embed"), "patch_b": ("embed_nr",),
+        "pos": (None, "embed"), "cls": (None, None, "embed_nr"),
+        "layers": {
+            "ln1_g": L + ("embed_nr",), "ln1_b": L + ("embed_nr",),
+            "wqkv": L + ("embed", "heads"), "bqkv": L + ("heads",),
+            "wo": L + ("heads", "embed"), "bo": L + ("embed_nr",),
+            "ln2_g": L + ("embed_nr",), "ln2_b": L + ("embed_nr",),
+            "w1": L + ("embed", "mlp"), "b1": L + ("mlp",),
+            "w2": L + ("mlp", "embed"), "b2": L + ("embed_nr",),
+        },
+        "lnf_g": ("embed_nr",), "lnf_b": ("embed_nr",),
+        "head_w": ("embed", None), "head_b": (None,),
+    }
+
+
+def init_params(key, cfg: ViTConfig) -> Dict[str, Any]:
+    pd = cfg.param_dtype
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    P = cfg.num_patches
+    k = iter(jax.random.split(key, 8))
+    init = lambda kk, shape, scale: jax.random.normal(kk, shape, pd) * scale
+    return {
+        "patch_w": init(next(k), (cfg.patch_dim, D), cfg.patch_dim ** -0.5),
+        "patch_b": jnp.zeros((D,), pd),
+        "pos": init(next(k), (P + 1, D), 0.02),
+        "cls": jnp.zeros((1, 1, D), pd),
+        "layers": {
+            "ln1_g": jnp.ones((L, D), pd), "ln1_b": jnp.zeros((L, D), pd),
+            "wqkv": init(next(k), (L, D, 3 * D), D ** -0.5),
+            "bqkv": jnp.zeros((L, 3 * D), pd),
+            "wo": init(next(k), (L, D, D), D ** -0.5),
+            "bo": jnp.zeros((L, D), pd),
+            "ln2_g": jnp.ones((L, D), pd), "ln2_b": jnp.zeros((L, D), pd),
+            "w1": init(next(k), (L, D, F), D ** -0.5),
+            "b1": jnp.zeros((L, F), pd),
+            "w2": init(next(k), (L, F, D), F ** -0.5),
+            "b2": jnp.zeros((L, D), pd),
+        },
+        "lnf_g": jnp.ones((D,), pd), "lnf_b": jnp.zeros((D,), pd),
+        "head_w": init(next(k), (D, cfg.num_classes), D ** -0.5),
+        "head_b": jnp.zeros((cfg.num_classes,), pd),
+    }
+
+
+def patchify(images, cfg: ViTConfig):
+    """[B, H, W, C] -> [B, P, patch_dim] via reshape (one GEMM follows)."""
+    B = images.shape[0]
+    p, n = cfg.patch_size, cfg.image_size // cfg.patch_size
+    x = images.reshape(B, n, p, n, p, cfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, n * n, cfg.patch_dim)
+
+
+def forward(params, images, cfg: ViTConfig):
+    """[B, H, W, C] float images -> [B, num_classes] f32 logits."""
+    dt = cfg.dtype
+    B = images.shape[0]
+    H, HD = cfg.n_heads, cfg.head_dim
+
+    x = patchify(images.astype(dt), cfg) @ params["patch_w"].astype(dt) \
+        + params["patch_b"].astype(dt)
+    cls = jnp.broadcast_to(params["cls"].astype(dt), (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"].astype(dt)
+    S = x.shape[1]
+
+    def body(x, lp):
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+        qkv = h @ lp["wqkv"].astype(dt) + lp["bqkv"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = _attention_xla(q.reshape(B, S, H, HD),
+                              k.reshape(B, S, H, HD),
+                              v.reshape(B, S, H, HD),
+                              causal=False).reshape(B, S, H * HD)
+        x = x + attn @ lp["wo"].astype(dt) + lp["bo"].astype(dt)
+        h = layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.norm_eps)
+        h = jax.nn.gelu(h @ lp["w1"].astype(dt) + lp["b1"].astype(dt))
+        x = x + h @ lp["w2"].astype(dt) + lp["b2"].astype(dt)
+        return x, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x[:, 0], params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+    logits = x @ params["head_w"].astype(dt) + params["head_b"].astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: ViTConfig, mesh=None):
+    """batch: {"images": [B,H,W,C], "labels": [B]} -> mean CE."""
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)[:, 0]
+    return nll.mean()
+
+
+def predict_fn(params, images, cfg: ViTConfig):
+    """Batch-inference entry (data.map_batches / serve replicas)."""
+    return jnp.argmax(forward(params, images, cfg), axis=-1)

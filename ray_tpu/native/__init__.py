@@ -1,7 +1,9 @@
 """Native (C++) components of ray_tpu.
 
-Currently: the shared-memory object store (objstore.cc), the host tier of the
-object plane (reference: src/ray/object_manager/plasma/). Compiled lazily on
+Currently: the shared-memory object store (objstore.cc — the host tier of
+the object plane, reference: src/ray/object_manager/plasma/) and the
+zero-staging TCP transfer plane (xfer.cc — reference:
+src/ray/object_manager/object_manager.cc push/pull). Compiled lazily on
 first import so a fresh checkout needs no separate build step.
 """
 
@@ -13,10 +15,12 @@ OBJSTORE_SO = os.path.join(_HERE, "libraytpu_objstore.so")
 
 
 def ensure_built() -> str:
-    """Compile the native library if missing or older than its source."""
-    src = os.path.join(_HERE, "objstore.cc")
+    """Compile the native library if missing or older than its sources."""
+    srcs = [os.path.join(_HERE, "objstore.cc"),
+            os.path.join(_HERE, "xfer.cc")]
     if (not os.path.exists(OBJSTORE_SO)
-            or os.path.getmtime(OBJSTORE_SO) < os.path.getmtime(src)):
+            or os.path.getmtime(OBJSTORE_SO) < max(
+                os.path.getmtime(s) for s in srcs)):
         subprocess.run(
             ["make", "-C", _HERE, "all"],
             check=True,

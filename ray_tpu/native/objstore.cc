@@ -60,6 +60,7 @@ enum ObjState : uint32_t {
 struct Entry {
   uint8_t id[kIdLen];
   uint32_t state;
+  uint32_t pending_delete;  // delete arrived while pinned; freed on last release
   uint64_t offset;    // into heap
   uint64_t size;      // user payload size
   uint64_t capacity;  // allocated block size (>= size)
@@ -378,6 +379,7 @@ uint64_t ts_create_buf(void* sp, const uint8_t* id, uint64_t size) {
   }
   memcpy(e->id, id, kIdLen);
   e->state = kCreating;
+  e->pending_delete = 0;
   e->offset = off;
   e->size = size;
   e->capacity = size;
@@ -396,6 +398,15 @@ int ts_seal(void* sp, const uint8_t* id) {
   if (e == nullptr || e->state != kCreating) {
     unlock(h);
     return -1;
+  }
+  if (e->pending_delete) {
+    // deleted while still being written: finish as a free, not a seal
+    heap_free(h, e->offset, e->capacity);
+    e->state = kFree;
+    e->pending_delete = 0;
+    h->num_objects--;
+    unlock(h);
+    return 0;
   }
   e->state = kSealed;
   e->refcount = 0;  // creator pin released; caller re-pins via ts_get if needed
@@ -446,7 +457,7 @@ uint64_t ts_get(void* sp, const uint8_t* id, uint64_t* size_out) {
   Header* h = s->hdr;
   if (lock(h) != 0) return 0;
   Entry* e = find_slot(h, id, false);
-  if (e == nullptr || e->state != kSealed) {
+  if (e == nullptr || e->state != kSealed || e->pending_delete) {
     unlock(h);
     return 0;
   }
@@ -468,6 +479,12 @@ int ts_release(void* sp, const uint8_t* id) {
     return -1;
   }
   if (e->refcount > 0) e->refcount--;
+  if (e->refcount == 0 && e->pending_delete) {
+    heap_free(h, e->offset, e->capacity);
+    e->state = kFree;
+    e->pending_delete = 0;
+    h->num_objects--;
+  }
   unlock(h);
   return 0;
 }
@@ -477,14 +494,17 @@ int ts_contains(void* sp, const uint8_t* id) {
   Header* h = s->hdr;
   if (lock(h) != 0) return 0;
   Entry* e = find_slot(h, id, false);
-  int r = (e != nullptr && e->state == kSealed) ? 1 : 0;
+  int r = (e != nullptr && e->state == kSealed && !e->pending_delete)
+              ? 1 : 0;
   unlock(h);
   return r;
 }
 
-// Delete a sealed object (refcount ignored — caller is the owner runtime,
-// which has already decided the object is out of scope; matches
-// LocalObjectManager free semantics).
+// Delete an object. If it is pinned (a reader holds a view, or the
+// native transfer plane is mid-send), the free is DEFERRED to the last
+// ts_release — freeing the heap region under an active reader would let
+// a concurrent allocation reuse it and corrupt the bytes in flight.
+// Unpinned objects free immediately (LocalObjectManager free semantics).
 int ts_delete(void* sp, const uint8_t* id) {
   Store* s = reinterpret_cast<Store*>(sp);
   Header* h = s->hdr;
@@ -494,8 +514,14 @@ int ts_delete(void* sp, const uint8_t* id) {
     unlock(h);
     return -1;
   }
+  if (e->refcount > 0) {
+    e->pending_delete = 1;
+    unlock(h);
+    return 0;
+  }
   heap_free(h, e->offset, e->capacity);
   e->state = kFree;
+  e->pending_delete = 0;
   h->num_objects--;
   unlock(h);
   return 0;
@@ -584,6 +610,26 @@ uint32_t ts_num_objects(void* sp) {
 }
 uint64_t ts_num_evictions(void* sp) {
   return reinterpret_cast<Store*>(sp)->hdr->num_evictions;
+}
+
+// Segment base pointer, for in-process zero-copy consumers of ts_get
+// offsets (the native transfer plane in xfer.cc reads/writes the heap
+// directly: shm -> socket with no userspace staging buffer).
+void* ts_seg_base(void* sp) { return reinterpret_cast<Store*>(sp)->base; }
+
+// Entry state probe: 0 = absent, 1 = creating (a racing producer/puller
+// is mid-write), 2 = sealed. Lets the transfer plane distinguish
+// "already here / arriving" from "allocation failed".
+int ts_state(void* sp, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(sp);
+  Header* h = s->hdr;
+  if (lock(h) != 0) return 0;
+  Entry* e = find_slot(h, id, false);
+  int r = 0;
+  if (e != nullptr && e->state == kCreating) r = 1;
+  if (e != nullptr && e->state == kSealed && !e->pending_delete) r = 2;
+  unlock(h);
+  return r;
 }
 
 }  // extern "C"
