@@ -820,29 +820,35 @@ class Nodelet:
         if port <= 0:
             return False
         host = source[0]
-        rc = await asyncio.to_thread(self.store.xfer_fetch, host, port, oid)
+        rc, total = await asyncio.to_thread(self.store.xfer_fetch, host,
+                                            port, oid)
         if rc == 3 and self.spill is not None:
-            # allocation failed: free space (spill-before-evict) and retry
-            await self._spill_pass(self.cfg.object_store_memory // 4)
-            rc = await asyncio.to_thread(self.store.xfer_fetch, host, port,
-                                         oid)
+            # allocation failed: free exactly what the object needs (the
+            # source already told us) plus slack, then retry
+            await self._spill_pass(max(total,
+                                       self.cfg.object_store_memory // 8))
+            rc, total = await asyncio.to_thread(self.store.xfer_fetch, host,
+                                                port, oid)
         if rc == 5:
             # a racing pull/producer owns the buffer: wait for its seal
-            # instead of transferring a second copy
-            deadline = time.time() + 60.0
+            # instead of transferring a second copy. No fixed deadline
+            # while it is actively kCreating (a slow multi-GB transfer is
+            # progress, not a hang); the io timeout on the racer's socket
+            # bounds a truly dead peer.
+            deadline = time.time() + 900.0
             while time.time() < deadline:
                 if self.store.contains(oid):
                     return True
                 st = self.store.state(oid)
                 if st == 0:   # racer aborted; retry once natively
-                    rc2 = await asyncio.to_thread(self.store.xfer_fetch,
-                                                  host, port, oid)
+                    rc2, _ = await asyncio.to_thread(self.store.xfer_fetch,
+                                                     host, port, oid)
                     if rc2 == 0:
                         self._native_pulls += 1
                         return True
                     if rc2 != 5:
                         return False
-                await asyncio.sleep(0.02)
+                await asyncio.sleep(0.05)
             return False
         if rc == 2:
             # io error: peer may have restarted on a new port — requery

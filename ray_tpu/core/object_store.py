@@ -253,13 +253,18 @@ class SharedMemoryStore:
     def xfer_serve_stop(self) -> None:
         self._lib.ts_xfer_serve_stop()
 
-    def xfer_fetch(self, host: str, port: int, oid: ObjectID) -> int:
+    def xfer_fetch(self, host: str, port: int,
+                   oid: ObjectID) -> "tuple[int, int]":
         """Blocking fetch of one remote object straight into this store.
-        0=ok 1=absent-at-source 2=io-error 3=alloc-failed 4=protocol."""
+        Returns (rc, total_bytes): rc 0=ok 1=absent-at-source 2=io-error
+        3=alloc-failed 4=protocol 5=already-local/arriving. total is the
+        source-reported size (0 when unknown) — on rc=3 it tells the
+        caller exactly how much space to free."""
         total = ctypes.c_uint64(0)
-        return int(self._lib.ts_xfer_fetch(
+        rc = int(self._lib.ts_xfer_fetch(
             self._h, host.encode(), port, oid.binary(),
             ctypes.byref(total)))
+        return rc, int(total.value)
 
     def bytes_in_use(self) -> int:
         return self._lib.ts_bytes_in_use(self._h)

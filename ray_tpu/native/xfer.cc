@@ -60,6 +60,7 @@ struct ServerState {
   int listen_fd = -1;
   void* store = nullptr;
   std::atomic<bool> stop{false};
+  std::atomic<uint64_t> generation{0};  // guards stale listener threads
 };
 
 ServerState g_server;
@@ -160,15 +161,20 @@ int ts_xfer_serve_start(void* store, const char* host, int port) {
   g_server.listen_fd = fd;
   g_server.store = store;
   g_server.stop.store(false);
+  uint64_t gen = g_server.generation.fetch_add(1) + 1;
 
-  std::thread([fd, store]() {
-    while (!g_server.stop.load()) {
+  std::thread([fd, store, gen]() {
+    while (!g_server.stop.load() && g_server.generation.load() == gen) {
       int conn = accept(fd, nullptr, nullptr);
       if (conn < 0) {
-        if (g_server.stop.load()) break;
-        continue;
+        if (g_server.stop.load() || g_server.generation.load() != gen)
+          break;                        // stale thread after stop/restart
+        if (errno == EINTR || errno == ECONNABORTED) continue;
+        if (errno == EBADF || errno == EINVAL) break;  // fd closed
+        usleep(10000);                  // EMFILE etc.: back off, don't spin
+      } else {
+        std::thread(handle_conn, conn, store).detach();
       }
-      std::thread(handle_conn, conn, store).detach();
     }
   }).detach();
   return (int)ntohs(addr.sin_port);
@@ -177,6 +183,7 @@ int ts_xfer_serve_start(void* store, const char* host, int port) {
 void ts_xfer_serve_stop() {
   if (g_server.listen_fd < 0) return;
   g_server.stop.store(true);
+  g_server.generation.fetch_add(1);  // invalidate the listener thread
   // shutdown unblocks accept() reliably; close alone may not
   shutdown(g_server.listen_fd, SHUT_RDWR);
   close(g_server.listen_fd);

@@ -15,6 +15,9 @@ the policy update is one jitted SPMD step on the TPU mesh.
 """
 
 from ray_tpu.rl.a2c import A2CConfig, A2CTrainer
+from ray_tpu.rl.apex import (ApexDQNConfig, ApexDQNTrainer,
+                             PrioritizedReplayActor,
+                             PrioritizedReplayBuffer)
 from ray_tpu.rl.appo import APPOConfig, APPOTrainer
 from ray_tpu.rl.bandit import (BanditConfig, LinearDiscreteBanditEnv,
                                LinTSTrainer, LinUCBTrainer)
@@ -47,6 +50,7 @@ _REGISTRY = {
     "CQL": (CQLConfig, CQLTrainer),
     "MultiAgentPPO": (MultiAgentPPOConfig, MultiAgentPPOTrainer),
     "APPO": (APPOConfig, APPOTrainer),
+    "ApexDQN": (ApexDQNConfig, ApexDQNTrainer),
     "DDPG": (DDPGConfig, DDPGTrainer),
     "ES": (ESConfig, ESTrainer),
     "ARS": (ARSConfig, ARSTrainer),
@@ -78,6 +82,8 @@ __all__ = [
     "PolicyServer", "PolicyClient", "ExternalPPOConfig",
     "ExternalPPOTrainer",
     "APPOConfig", "APPOTrainer", "DDPGConfig", "DDPGTrainer",
+    "ApexDQNConfig", "ApexDQNTrainer", "PrioritizedReplayBuffer",
+    "PrioritizedReplayActor",
     "ESConfig", "ESTrainer", "ARSConfig", "ARSTrainer",
     "BanditConfig", "LinUCBTrainer", "LinTSTrainer",
     "LinearDiscreteBanditEnv",

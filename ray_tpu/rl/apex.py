@@ -1,0 +1,268 @@
+"""APEX-DQN: distributed prioritized replay with an async worker fleet.
+
+Reference: rllib/algorithms/apex_dqn/ (Horgan et al. 2018 — many actors
+with per-actor exploration epsilons feed a sharded prioritized replay;
+the learner consumes batches asynchronously and pushes updated
+priorities + weights back). The replay shard is an actor
+(core.ReplayActor pattern); the Q-network and TD math are shared with
+ray_tpu.rl.dqn.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import Algorithm, probe_env_spec
+from ray_tpu.rl.dqn import _EpsilonWorker, init_qnet, q_forward
+
+
+class PrioritizedReplayBuffer:
+    """Proportional prioritized replay (ref:
+    rllib/utils/replay_buffers/prioritized_replay_buffer.py): P(i) ~
+    p_i^alpha, importance weights w_i = (N*P(i))^-beta / max w."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, seed: int = 0):
+        self.capacity = capacity
+        self.alpha = alpha
+        self._storage: Dict[str, np.ndarray] = {}
+        self._prio = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        n = len(next(iter(batch.values())))
+        if not self._storage:
+            for k, v in batch.items():
+                v = np.asarray(v)
+                self._storage[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                            v.dtype)
+        idx = (self._idx + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._storage[k][idx] = np.asarray(v)
+        self._prio[idx] = self._max_prio  # new samples get max priority
+        self._idx = (self._idx + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int, beta: float = 0.4):
+        p = self._prio[:self._size] ** self.alpha
+        p = p / p.sum()
+        idx = self._rng.choice(self._size, batch_size, p=p)
+        weights = (self._size * p[idx]) ** (-beta)
+        weights = weights / weights.max()
+        out = {k: v[idx] for k, v in self._storage.items()}
+        out["_weights"] = weights.astype(np.float32)
+        out["_indices"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, indices: np.ndarray, prios: np.ndarray):
+        prios = np.abs(prios) + 1e-6
+        self._prio[indices] = prios
+        self._max_prio = max(self._max_prio, float(prios.max()))
+
+    def __len__(self):
+        return self._size
+
+
+@ray_tpu.remote
+class PrioritizedReplayActor:
+    """One replay shard (ref: apex ReplayActor fleet)."""
+
+    def __init__(self, capacity: int, alpha: float, seed: int = 0):
+        self.buf = PrioritizedReplayBuffer(capacity, alpha, seed)
+
+    def add_batch(self, batch):
+        self.buf.add_batch(batch)
+        return len(self.buf)
+
+    def sample(self, batch_size: int, beta: float):
+        if len(self.buf) < batch_size:
+            return None
+        return self.buf.sample(batch_size, beta)
+
+    def update_priorities(self, indices, prios):
+        self.buf.update_priorities(np.asarray(indices), np.asarray(prios))
+        return True
+
+    def size(self):
+        return len(self.buf)
+
+
+@dataclass
+class ApexDQNConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 3
+    num_replay_shards: int = 1
+    rollout_fragment_length: int = 50
+    replay_capacity: int = 50_000
+    learning_starts: int = 300
+    train_batch_size: int = 64
+    updates_per_iter: int = 16
+    lr: float = 1e-3
+    gamma: float = 0.99
+    double_q: bool = True
+    dueling: bool = True
+    target_network_update_freq: int = 500
+    # per-worker exploration: eps_i = base^(1 + 7*i/(N-1)) (ref: apex
+    # paper eq. 1 via rllib per_worker_exploration)
+    epsilon_base: float = 0.4
+    prioritized_alpha: float = 0.6
+    prioritized_beta: float = 0.4
+    hidden: int = 64
+    seed: int = 0
+
+
+class ApexDQNTrainer(Algorithm):
+    """Async fan-in: one in-flight sample per worker lands in a replay
+    shard while the learner trains; weights rebroadcast on relaunch
+    (ref: apex_dqn.py training_step)."""
+
+    def _setup(self, cfg: ApexDQNConfig):
+        import jax
+        import optax
+
+        obs_dim, n_actions, _, _ = probe_env_spec(cfg.env, cfg.env_config)
+        assert n_actions is not None, "APEX-DQN is discrete-action"
+        self.net = init_qnet(jax.random.PRNGKey(cfg.seed), obs_dim,
+                             n_actions, cfg.hidden, cfg.dueling)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.net)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.net)
+        self.shards = [
+            PrioritizedReplayActor.options(num_cpus=0.2).remote(
+                cfg.replay_capacity // cfg.num_replay_shards,
+                cfg.prioritized_alpha, cfg.seed + s)
+            for s in range(cfg.num_replay_shards)]
+        self.workers = [
+            _EpsilonWorker.options(num_cpus=0.4).remote(
+                cfg.env, cfg.seed + i * 1000, cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        n = max(1, cfg.num_rollout_workers - 1)
+        self._eps = [cfg.epsilon_base ** (1 + 7 * i / n)
+                     for i in range(cfg.num_rollout_workers)]
+        self._inflight: Dict[Any, int] = {}   # sample ref -> worker index
+        self.timesteps = 0
+        self._since_target_sync = 0
+        self.num_updates = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(net, target, mb):
+            q = q_forward(net, mb["obs"])
+            q_sel = jnp.take_along_axis(q, mb["actions"][:, None], -1)[:, 0]
+            q_next_t = q_forward(target, mb["next_obs"])
+            if cfg.double_q:
+                a_star = q_forward(net, mb["next_obs"]).argmax(-1)
+                q_next = jnp.take_along_axis(q_next_t, a_star[:, None],
+                                             -1)[:, 0]
+            else:
+                q_next = q_next_t.max(-1)
+            tq = mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * q_next
+            td = q_sel - jax.lax.stop_gradient(tq)
+            # importance-weighted MSE; |td| goes back as new priorities
+            loss = (mb["_weights"] * jnp.square(td)).mean()
+            return loss, jnp.abs(td)
+
+        def update(net, target, opt_state, mb):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(net, target, mb)
+            updates, opt_state = self.opt.update(grads, opt_state, net)
+            net = optax.apply_updates(net, updates)
+            return net, opt_state, loss, td
+
+        return update
+
+    def _launch(self, i: int, net_host):
+        ref = self.workers[i].sample.remote(
+            net_host, self.config.rollout_fragment_length, self._eps[i])
+        self._inflight[ref] = i
+
+    def _shard(self, i: int):
+        return self.shards[i % len(self.shards)]
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        net_host = jax.device_get(self.net)
+        for i in range(len(self.workers)):
+            if i not in self._inflight.values():
+                self._launch(i, net_host)
+
+        # drain landed samples into shards (non-blocking fan-in)
+        ready, _ = ray_tpu.wait(list(self._inflight),
+                                num_returns=len(self._inflight), timeout=0.2)
+        for ref in ready:
+            i = self._inflight.pop(ref)
+            b = ray_tpu.get(ref)
+            n = len(b["rewards"])
+            self.timesteps += n
+            self._since_target_sync += n
+            self._shard(i).add_batch.remote(b)
+            # net is unchanged until the update loop below; reuse the
+            # host copy instead of a device_get per landed sample
+            self._launch(i, net_host)
+
+        loss = float("nan")
+        updates = 0
+        sizes = ray_tpu.get([s.size.remote() for s in self.shards])
+        if sum(sizes) >= cfg.learning_starts:
+            for u in range(cfg.updates_per_iter):
+                shard = self.shards[u % len(self.shards)]
+                mb = ray_tpu.get(shard.sample.remote(
+                    cfg.train_batch_size, cfg.prioritized_beta))
+                if mb is None:
+                    continue
+                indices = mb.pop("_indices")
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                self.net, self.opt_state, loss, td = self._update(
+                    self.net, self.target, self.opt_state, mb)
+                shard.update_priorities.remote(indices, np.asarray(td))
+                updates += 1
+                self.num_updates += 1
+            if self._since_target_sync >= cfg.target_network_update_freq:
+                self.target = jax.tree_util.tree_map(lambda x: x, self.net)
+                self._since_target_sync = 0
+            loss = float(loss)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "num_updates": self.num_updates,
+            "updates_this_iter": updates,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "replay_size": sum(sizes),
+            "loss": loss,
+        }
+
+    def get_weights(self):
+        return self.net
+
+    def set_weights(self, weights):
+        import jax
+
+        self.net = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, weights)
+
+    def stop(self):
+        for a in self.workers + self.shards:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -17,6 +17,8 @@ from completed steps instead of recomputing.
     # re-running with the same workflow_id skips completed steps
 """
 
-from ray_tpu.workflow.api import StepNode, run, step
+from ray_tpu.workflow.api import (StepNode, get_status, list_all, resume,
+                                  run, run_async, step)
 
-__all__ = ["step", "run", "StepNode"]
+__all__ = ["step", "run", "run_async", "resume", "get_status",
+           "list_all", "StepNode"]

@@ -62,10 +62,24 @@ def _storage_path(storage: str, workflow_id: str, key: str) -> str:
     return os.path.join(d, key + ".pkl")
 
 
+def _write_status(storage: str, workflow_id: str, status: str,
+                  error: Optional[str] = None):
+    d = os.path.join(storage, workflow_id)
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, "status.tmp")
+    with open(tmp, "w") as f:
+        f.write(status + ("\n" + error if error else ""))
+    os.replace(tmp, os.path.join(d, "status"))
+
+
 def run(node: StepNode, *, workflow_id: str, storage: str) -> Any:
     """Execute the DAG depth-first; persist each step result; resume skips
-    persisted steps (ref: workflow durability contract)."""
+    persisted steps (ref: workflow durability contract). A step may
+    RETURN a StepNode — a continuation (ref: workflow.continuation) —
+    which the executor keeps resolving, enabling dynamic/recursive
+    workflows with every intermediate step still checkpointed."""
     memo: Dict[str, Any] = {}
+    _write_status(storage, workflow_id, "RUNNING")
 
     def resolve(n: StepNode) -> Any:
         key = n.key()
@@ -82,6 +96,8 @@ def run(node: StepNode, *, workflow_id: str, storage: str) -> Any:
                   for k, v in n.kwargs.items()}
         task = ray_tpu.remote(n.fn).options(max_retries=n.max_retries)
         out = ray_tpu.get(task.remote(*args, **kwargs))
+        while isinstance(out, StepNode):   # continuation
+            out = resolve(out)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(out, f)
@@ -89,4 +105,48 @@ def run(node: StepNode, *, workflow_id: str, storage: str) -> Any:
         memo[key] = out
         return out
 
-    return resolve(node)
+    try:
+        out = resolve(node)
+    except BaseException as e:
+        _write_status(storage, workflow_id, "FAILED", repr(e))
+        raise
+    _write_status(storage, workflow_id, "SUCCESSFUL")
+    return out
+
+
+def run_async(node: StepNode, *, workflow_id: str, storage: str):
+    """Start the workflow on a thread; returns a concurrent Future
+    (ref: workflow/api.py run_async returning an ObjectRef)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(run, node, workflow_id=workflow_id, storage=storage)
+    pool.shutdown(wait=False)
+    return fut
+
+
+def get_status(workflow_id: str, *, storage: str) -> str:
+    """RUNNING / SUCCESSFUL / FAILED / NOT_FOUND (ref: workflow
+    get_status)."""
+    p = os.path.join(storage, workflow_id, "status")
+    if not os.path.exists(p):
+        return "NOT_FOUND"
+    with open(p) as f:
+        return f.read().splitlines()[0]
+
+
+def list_all(*, storage: str) -> List[tuple]:
+    """[(workflow_id, status)] for every workflow under the storage dir
+    (ref: workflow.list_all)."""
+    if not os.path.isdir(storage):
+        return []
+    return [(wid, get_status(wid, storage=storage))
+            for wid in sorted(os.listdir(storage))
+            if os.path.isdir(os.path.join(storage, wid))]
+
+
+def resume(node: StepNode, *, workflow_id: str, storage: str) -> Any:
+    """Re-run a FAILED/interrupted workflow; persisted steps are skipped
+    (ref: workflow.resume — the DAG is re-supplied since this build
+    stores step results, not pickled DAGs)."""
+    return run(node, workflow_id=workflow_id, storage=storage)

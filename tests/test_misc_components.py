@@ -260,3 +260,34 @@ def test_tpu_pod_provider_command_protocol():
     state[nid] = "ACTIVE"
     p.terminate_node(nid)
     assert p.non_terminated_nodes() == []
+
+
+def test_workflow_continuation_and_status(ray_start_regular, tmp_path):
+    """Dynamic workflows: a step returning a StepNode continues the DAG
+    (ref: workflow.continuation); status + listing APIs reflect runs."""
+    from ray_tpu import workflow
+
+    @workflow.step
+    def fib(n):
+        if n <= 1:
+            return n
+        # continuation: this step RETURNS more workflow, checkpointed too
+        return add.bind(fib.bind(n - 1), fib.bind(n - 2))
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    storage = str(tmp_path)
+    out = workflow.run(fib.bind(7), workflow_id="fib", storage=storage)
+    assert out == 13
+    assert workflow.get_status("fib", storage=storage) == "SUCCESSFUL"
+    assert ("fib", "SUCCESSFUL") in workflow.list_all(storage=storage)
+
+    # async run + resume
+    fut = workflow.run_async(fib.bind(8), workflow_id="fib8",
+                             storage=storage)
+    assert fut.result(timeout=120) == 21
+    assert workflow.resume(fib.bind(8), workflow_id="fib8",
+                           storage=storage) == 21
+    assert workflow.get_status("nope", storage=storage) == "NOT_FOUND"

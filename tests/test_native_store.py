@@ -58,18 +58,19 @@ def test_xfer_roundtrip_and_statuses(stores):
     payload = np.random.default_rng(0).bytes(2 << 20)
     assert a.put_bytes(oid, payload)
 
-    assert b.xfer_fetch("127.0.0.1", port, oid) == 0
+    rc, total = b.xfer_fetch("127.0.0.1", port, oid)
+    assert rc == 0 and total == len(payload)
     got = b.get_view(oid)
     assert bytes(got) == payload
     del got
     b.release(oid)
 
     # absent at source
-    assert b.xfer_fetch("127.0.0.1", port, ObjectID.from_random()) == 1
+    assert b.xfer_fetch("127.0.0.1", port, ObjectID.from_random())[0] == 1
     # already local -> 5 (NOT 3: callers must not spill for a duplicate)
-    assert b.xfer_fetch("127.0.0.1", port, oid) == 5
+    assert b.xfer_fetch("127.0.0.1", port, oid)[0] == 5
     # connection refused
-    assert b.xfer_fetch("127.0.0.1", 1, oid) == 2
+    assert b.xfer_fetch("127.0.0.1", 1, oid)[0] == 2
 
 
 def test_xfer_delete_race_keeps_stream_intact(stores):
@@ -86,7 +87,7 @@ def test_xfer_delete_race_keeps_stream_intact(stores):
     results = {}
 
     def fetch():
-        results["rc"] = b.xfer_fetch("127.0.0.1", port, oid)
+        results["rc"] = b.xfer_fetch("127.0.0.1", port, oid)[0]
 
     t = threading.Thread(target=fetch)
     t.start()
