@@ -830,12 +830,13 @@ class Nodelet:
             rc, total = await asyncio.to_thread(self.store.xfer_fetch, host,
                                                 port, oid)
         if rc == 5:
-            # a racing pull/producer owns the buffer: wait for its seal
-            # instead of transferring a second copy. No fixed deadline
-            # while it is actively kCreating (a slow multi-GB transfer is
-            # progress, not a hang); the io timeout on the racer's socket
-            # bounds a truly dead peer.
-            deadline = time.time() + 900.0
+            # A racing pull/producer owns the buffer: wait for its seal
+            # instead of transferring a second copy. Bounded: a racer
+            # SIGKILLed mid-write leaves the entry kCreating forever (no
+            # progress signal is exposed), so after the io-timeout window
+            # the native path gives up and the chunk-RPC fallback's own
+            # create/contains logic takes over.
+            deadline = time.time() + 150.0
             while time.time() < deadline:
                 if self.store.contains(oid):
                     return True

@@ -172,6 +172,14 @@ int ts_xfer_serve_start(void* store, const char* host, int port) {
         if (errno == EINTR || errno == ECONNABORTED) continue;
         if (errno == EBADF || errno == EINVAL) break;  // fd closed
         usleep(10000);                  // EMFILE etc.: back off, don't spin
+      } else if (g_server.stop.load() ||
+                 g_server.generation.load() != gen) {
+        // stale thread raced a restart and won accept() on a REUSED fd
+        // number: this connection belongs to the new server's socket but
+        // our captured store pointer is stale — drop it, the client
+        // retries and lands on the live listener
+        close(conn);
+        break;
       } else {
         std::thread(handle_conn, conn, store).detach();
       }

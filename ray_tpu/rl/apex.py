@@ -16,38 +16,28 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, probe_env_spec
+from ray_tpu.rl.core import Algorithm, ReplayBuffer, probe_env_spec
 from ray_tpu.rl.dqn import _EpsilonWorker, init_qnet, q_forward
 
 
-class PrioritizedReplayBuffer:
+class PrioritizedReplayBuffer(ReplayBuffer):
     """Proportional prioritized replay (ref:
     rllib/utils/replay_buffers/prioritized_replay_buffer.py): P(i) ~
-    p_i^alpha, importance weights w_i = (N*P(i))^-beta / max w."""
+    p_i^alpha, importance weights w_i = (N*P(i))^-beta / max w. Storage
+    and wraparound come from the uniform core.ReplayBuffer; this layer
+    adds only the priority bookkeeping."""
 
     def __init__(self, capacity: int, alpha: float = 0.6, seed: int = 0):
-        self.capacity = capacity
+        super().__init__(capacity, seed)
         self.alpha = alpha
-        self._storage: Dict[str, np.ndarray] = {}
         self._prio = np.zeros(capacity, np.float64)
         self._max_prio = 1.0
-        self._idx = 0
-        self._size = 0
-        self._rng = np.random.default_rng(seed)
 
     def add_batch(self, batch: Dict[str, np.ndarray]):
         n = len(next(iter(batch.values())))
-        if not self._storage:
-            for k, v in batch.items():
-                v = np.asarray(v)
-                self._storage[k] = np.zeros((self.capacity,) + v.shape[1:],
-                                            v.dtype)
         idx = (self._idx + np.arange(n)) % self.capacity
-        for k, v in batch.items():
-            self._storage[k][idx] = np.asarray(v)
+        super().add_batch(batch)
         self._prio[idx] = self._max_prio  # new samples get max priority
-        self._idx = (self._idx + n) % self.capacity
-        self._size = min(self._size + n, self.capacity)
 
     def sample(self, batch_size: int, beta: float = 0.4):
         p = self._prio[:self._size] ** self.alpha
@@ -64,9 +54,6 @@ class PrioritizedReplayBuffer:
         prios = np.abs(prios) + 1e-6
         self._prio[indices] = prios
         self._max_prio = max(self._max_prio, float(prios.max()))
-
-    def __len__(self):
-        return self._size
 
 
 @ray_tpu.remote

@@ -83,6 +83,16 @@ class TrainWorker:
                 "latest_checkpoint": (ctx.latest_checkpoint.path
                                       if ctx and ctx.latest_checkpoint else None)}
 
+    def set_rank(self, rank: int, world_size: int) -> bool:
+        """Rank/world refresh after an elastic resize (the next setup()
+        or user-loop restart sees the new topology)."""
+        self.rank = rank
+        self.world_size = world_size
+        if self.ctx is not None:
+            self.ctx.world_rank = rank
+            self.ctx.world_size = world_size
+        return True
+
     def host_info(self) -> dict:
         import socket
 
@@ -114,12 +124,15 @@ class WorkerGroup:
                  placement_strategy: str = "PACK"):
         self.num_workers = num_workers
         self.resources = resources_per_worker
+        self.placement_strategy = placement_strategy
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
         self.pg = placement_group(bundles, strategy=placement_strategy)
         if not self.pg.ready(timeout=60):
             remove_placement_group(self.pg)
             raise ray_tpu.exceptions.PlacementGroupUnavailableError(
                 f"could not reserve {num_workers} x {resources_per_worker}")
+        self._extra_pgs: List[Any] = []
+        self._worker_pg: Dict[Any, Any] = {}   # worker -> its pg
         self.workers = []
         for rank in range(num_workers):
             w = TrainWorker.options(
@@ -131,11 +144,70 @@ class WorkerGroup:
                     placement_group_bundle_index=rank),
             ).remote(rank, num_workers)
             self.workers.append(w)
+            self._worker_pg[w] = self.pg
 
     def broadcast(self, method: str, *args, **kwargs):
         refs = [getattr(w, method).remote(*args, **kwargs)
                 for w in self.workers]
         return ray_tpu.get(refs)
+
+    # ---- elasticity (ref: worker_group.py:318 remove_workers /
+    #      :333 add_workers; BackendExecutor resizes then re-ranks) ------
+
+    def remove_workers(self, indices: List[int]) -> None:
+        """Drop workers by index (dead or drained); ranks are refreshed
+        across the survivors. A supplemental PG whose workers are all
+        gone is removed so its bundles return to the cluster; bundles of
+        the ORIGINAL PG stay reserved until shutdown (placement groups
+        cannot shrink — same contract as the reference)."""
+        for i in sorted(set(indices), reverse=True):
+            w = self.workers.pop(i)
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+            self._worker_pg.pop(w, None)
+        live_pgs = set(map(id, self._worker_pg.values()))
+        for pg in list(self._extra_pgs):
+            if id(pg) not in live_pgs:
+                self._extra_pgs.remove(pg)
+                try:
+                    remove_placement_group(pg)
+                except Exception:
+                    pass
+        self.num_workers = len(self.workers)
+        self._reassign_ranks()
+
+    def add_workers(self, n: int, timeout: float = 60.0) -> None:
+        """Grow the gang by n workers. New workers reserve a supplemental
+        placement group with the group's original strategy (the original
+        PG's bundle count is fixed)."""
+        bundles = [dict(self.resources) for _ in range(n)]
+        pg = placement_group(bundles, strategy=self.placement_strategy)
+        if not pg.ready(timeout=timeout):
+            remove_placement_group(pg)
+            raise ray_tpu.exceptions.PlacementGroupUnavailableError(
+                f"could not reserve {n} x {self.resources} to grow the "
+                "worker group")
+        self._extra_pgs.append(pg)
+        base = len(self.workers)
+        for i in range(n):
+            w = TrainWorker.options(
+                num_cpus=0,
+                resources={k: v for k, v in self.resources.items()},
+                max_concurrency=2,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i),
+            ).remote(base + i, base + n)
+            self.workers.append(w)
+            self._worker_pg[w] = pg
+        self.num_workers = len(self.workers)
+        self._reassign_ranks()
+
+    def _reassign_ranks(self):
+        n = len(self.workers)
+        ray_tpu.get([w.set_rank.remote(rank, n)
+                     for rank, w in enumerate(self.workers)])
 
     def shutdown(self):
         for w in self.workers:
@@ -143,7 +215,8 @@ class WorkerGroup:
                 ray_tpu.kill(w)
             except Exception:
                 pass
-        try:
-            remove_placement_group(self.pg)
-        except Exception:
-            pass
+        for pg in ([self.pg] + self._extra_pgs):
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass

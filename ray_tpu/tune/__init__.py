@@ -20,7 +20,8 @@ search algorithms (search/basic_variant.py grid/random), trial schedulers
 """
 
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
-                                 RandomSearch, Searcher, TPESearcher, choice,
+                                 BayesOptSearch, RandomSearch, Searcher,
+                                 TPESearcher, choice,
                                  grid_search, loguniform, randint, uniform)
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler, MedianStoppingRule,
@@ -34,5 +35,6 @@ __all__ = [
     "uniform", "loguniform", "randint", "ASHAScheduler", "FIFOScheduler",
     "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
+    "BayesOptSearch",
     "ConcurrencyLimiter",
 ]

@@ -312,3 +312,119 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class BayesOptSearch(Searcher):
+    """GP-based Bayesian optimization with Expected Improvement
+    (ref: search/bayesopt/bayesopt_search.py — the reference wraps the
+    `bayesian-optimization` package; this is the same GP+EI loop on
+    sklearn's GaussianProcessRegressor, which the TPU image carries).
+
+    Numeric params (Uniform/LogUniform/RandInt) are modeled in a unit
+    hypercube (log-space for LogUniform); Choice params are sampled
+    randomly per suggestion (categorical kernels are out of scope, as in
+    the reference's wrapper).
+    """
+
+    def __init__(self, metric: str | None = None, mode: str = "max",
+                 n_startup_trials: int = 6, n_candidates: int = 256,
+                 xi: float = 0.01, seed: int = 0):
+        super().__init__(metric, mode)
+        self.n_startup = n_startup_trials
+        self.n_candidates = n_candidates
+        self.xi = xi
+        import numpy as _np
+
+        self._np = _np
+        self.rng = _np.random.default_rng(seed)
+        self._pyrng = random.Random(seed)
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+
+    def _numeric_keys(self):
+        out = []
+        for k, v in sorted(self.param_space.items()):
+            if isinstance(v, (Uniform, LogUniform, RandInt)):
+                out.append((k, v))
+        return out
+
+    def _encode(self, cfg) -> List[float]:
+        import math
+
+        x = []
+        for k, spec in self._numeric_keys():
+            v = float(cfg[k])
+            if isinstance(spec, LogUniform):
+                x.append((math.log(v) - spec.lo) / (spec.hi - spec.lo))
+            elif isinstance(spec, RandInt):
+                x.append((v - spec.lo) / max(1, spec.hi - 1 - spec.lo))
+            else:
+                x.append((v - spec.lo) / (spec.hi - spec.lo))
+        return x
+
+    def _decode(self, x) -> Dict[str, Any]:
+        import math
+
+        cfg = {}
+        for (k, spec), u in zip(self._numeric_keys(), x):
+            u = min(1.0, max(0.0, float(u)))
+            if isinstance(spec, LogUniform):
+                cfg[k] = math.exp(spec.lo + u * (spec.hi - spec.lo))
+            elif isinstance(spec, RandInt):
+                cfg[k] = int(round(spec.lo + u * max(1, spec.hi - 1
+                                                     - spec.lo)))
+            else:
+                cfg[k] = spec.lo + u * (spec.hi - spec.lo)
+        return cfg
+
+    def _non_numeric(self) -> Dict[str, Any]:
+        cfg = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, Choice):
+                cfg[k] = v.sample(self._pyrng)
+            elif isinstance(v, GridSearch):
+                cfg[k] = self._pyrng.choice(v.values)
+            elif not isinstance(v, Sampler):
+                cfg[k] = v
+        return cfg
+
+    def suggest(self, trial_id):
+        np = self._np
+        keys = self._numeric_keys()
+        if not keys:
+            return {**self._non_numeric()}
+        d = len(keys)
+        if len(self._y) < self.n_startup:
+            u = self.rng.random(d)
+            return {**self._non_numeric(), **self._decode(u)}
+
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), alpha=1e-6, normalize_y=True,
+            random_state=int(self.rng.integers(1 << 31)))
+        gp.fit(np.asarray(self._X), np.asarray(self._y))
+        cand = self.rng.random((self.n_candidates, d))
+        mu, sigma = gp.predict(cand, return_std=True)
+        best = max(self._y)
+        sigma = np.maximum(sigma, 1e-9)
+        z = (mu - best - self.xi) / sigma
+        from scipy.stats import norm  # scipy ships with sklearn deps
+
+        ei = (mu - best - self.xi) * norm.cdf(z) + sigma * norm.pdf(z)
+        return {**self._non_numeric(),
+                **self._decode(cand[int(np.argmax(ei))])}
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if error or not result or self.metric not in result:
+            return
+        val = float(result[self.metric])
+        if self.mode == "min":
+            val = -val
+        cfg = result["config"]
+        try:
+            self._X.append(self._encode(cfg))
+            self._y.append(val)
+        except (KeyError, ValueError):
+            pass

@@ -115,13 +115,23 @@ def run(node: StepNode, *, workflow_id: str, storage: str) -> Any:
 
 
 def run_async(node: StepNode, *, workflow_id: str, storage: str):
-    """Start the workflow on a thread; returns a concurrent Future
-    (ref: workflow/api.py run_async returning an ObjectRef)."""
-    from concurrent.futures import ThreadPoolExecutor
+    """Start the workflow on a daemon thread; returns a concurrent
+    Future (ref: workflow/api.py run_async returning an ObjectRef). A
+    daemon thread, not an executor: a hung workflow must not block
+    interpreter exit via the atexit pool join."""
+    import threading
+    from concurrent.futures import Future
 
-    pool = ThreadPoolExecutor(max_workers=1)
-    fut = pool.submit(run, node, workflow_id=workflow_id, storage=storage)
-    pool.shutdown(wait=False)
+    fut: Future = Future()
+
+    def work():
+        try:
+            fut.set_result(run(node, workflow_id=workflow_id,
+                               storage=storage))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=work, daemon=True).start()
     return fut
 
 

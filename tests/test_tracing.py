@@ -19,9 +19,19 @@ def cluster():
     ray_tpu.shutdown()
 
 
-def _spans():
-    return [e for e in ray_tpu.timeline(limit=2000)
-            if e.get("kind") == "span"]
+def _spans(expect_name=None, timeout=10.0):
+    """Snapshot spans; when expect_name is given, poll until a span with
+    that name lands (worker task-event buffers flush asynchronously)."""
+    import time
+
+    deadline = time.time() + timeout
+    while True:
+        out = [e for e in ray_tpu.timeline(limit=2000)
+               if e.get("kind") == "span"]
+        if expect_name is None or any(s["name"] == expect_name for s in out) \
+                or time.time() > deadline:
+            return out
+        time.sleep(0.2)
 
 
 def test_local_span_nesting(cluster):
@@ -45,7 +55,7 @@ def test_trace_propagates_to_task(cluster):
         child_trace = ray_tpu.get(traced_child.remote())
     assert child_trace == root["trace_id"]
 
-    spans = _spans()
+    spans = _spans(expect_name="task::traced_child")
     task_spans = [s for s in spans if s["name"] == "task::traced_child"]
     assert task_spans, spans
     ts = task_spans[-1]
@@ -66,7 +76,7 @@ def test_trace_propagates_to_actor(cluster):
         a = A.remote()
         t = ray_tpu.get(a.m.remote())
     assert t == root["trace_id"]
-    spans = _spans()
+    spans = _spans(expect_name="actor::m")
     m = [s for s in spans if s["name"] == "actor::m"]
     assert m and m[-1]["parent_id"] == root["span_id"]
     init = [s for s in spans if s["name"] == "actor::A.__init__"]

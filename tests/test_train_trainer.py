@@ -94,3 +94,25 @@ def test_trainer_user_error_surfaces(ray_start_regular, tmp_path):
     result = trainer.fit()
     assert not result.ok
     assert "user bug" in result.error
+
+
+def test_worker_group_elastic_resize(ray_start_regular):
+    """Elastic add/remove with rank reassignment (ref:
+    worker_group.py:318,333 + BackendExecutor resize-and-rerank)."""
+    from ray_tpu.train.worker_group import WorkerGroup
+
+    wg = WorkerGroup(num_workers=2, resources_per_worker={"CPU": 0.5})
+    try:
+        infos = wg.broadcast("host_info")
+        assert sorted(i["rank"] for i in infos) == [0, 1]
+
+        wg.remove_workers([0])
+        assert wg.num_workers == 1
+        assert wg.broadcast("host_info")[0]["rank"] == 0  # re-ranked
+
+        wg.add_workers(2)
+        assert wg.num_workers == 3
+        infos = wg.broadcast("host_info")
+        assert sorted(i["rank"] for i in infos) == [0, 1, 2]
+    finally:
+        wg.shutdown()

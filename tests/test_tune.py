@@ -186,3 +186,49 @@ def test_pbt_exploit_transfers_checkpoint(ray_start_regular):
     # final score should be far above what lr=0.001 alone could reach (0.03)
     finals = sorted(r.metrics["score"] for r in results)
     assert finals[0] > 0.1, finals
+
+
+def test_bayesopt_search_beats_random_on_quadratic(ray_start_regular):
+    """GP+EI must concentrate samples near the optimum of a smooth
+    objective (ref: BayesOptSearch wrapper semantics)."""
+    from ray_tpu import tune
+
+    def objective(config):
+        return {"score": -(config["x"] - 0.7) ** 2
+                         - (config["y"] - 0.3) ** 2}
+
+    searcher = tune.BayesOptSearch(n_startup_trials=5, seed=0)
+    results = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(0, 1), "y": tune.uniform(0, 1),
+                     "tag": tune.choice(["a", "b"])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=20, search_alg=searcher,
+                                    max_concurrent_trials=2),
+    ).fit()
+    best = results.get_best_result()
+    assert best.metrics["score"] > -0.02  # within ~0.14 of the optimum
+    assert best.config["tag"] in ("a", "b")
+
+
+def test_bayesopt_loguniform_and_randint(ray_start_regular):
+    from ray_tpu import tune
+
+    def objective(config):
+        import math
+
+        return {"loss": abs(math.log10(config["lr"]) + 2)
+                        + abs(config["layers"] - 3) * 0.1}
+
+    searcher = tune.BayesOptSearch(n_startup_trials=4, seed=1)
+    results = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1),
+                     "layers": tune.randint(1, 6)},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    num_samples=16, search_alg=searcher,
+                                    max_concurrent_trials=2),
+    ).fit()
+    best = results.get_best_result()
+    assert best.metrics["loss"] < 0.8
+    assert isinstance(best.config["layers"], int)
