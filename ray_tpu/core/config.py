@@ -28,6 +28,10 @@ class Config:
     # Native zero-staging transfer plane (native/xfer.cc); off -> always
     # use the portable chunk-RPC pull path.
     native_transfer_enabled: bool = True
+    # kCreating store entries older than this are orphans of a dead
+    # producer and get reaped (local writes take seconds; remote pulls
+    # are bounded by the 120s transfer socket timeout).
+    creating_orphan_age_s: float = 300.0
     # --- object spilling (ref: local_object_manager.h:41 + external_storage) -
     object_spill_enabled: bool = True
     object_spill_threshold: float = 0.8          # spill when usage crosses this

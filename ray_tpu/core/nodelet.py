@@ -183,10 +183,25 @@ class Nodelet:
 
     async def _reap_loop(self):
         """Detect worker deaths; free leases; report to GCS
-        (ref: NodeManager worker failure path / HandleUnexpectedWorkerFailure)."""
+        (ref: NodeManager worker failure path / HandleUnexpectedWorkerFailure).
+        Also reaps store buffers orphaned in kCreating by a producer that
+        died mid-write — without this the object id is permanently
+        unfetchable on this node (create always sees 'exists')."""
+        last_orphan_scan = time.time()
         while not self._stopping:
             await asyncio.sleep(0.1)
             now = time.time()
+            if now - last_orphan_scan > 30.0:
+                last_orphan_scan = now
+                try:
+                    n = self.store.reap_creating(
+                        self.cfg.creating_orphan_age_s)
+                    if n:
+                        logger.warning(
+                            "reaped %d orphaned in-creation store "
+                            "buffers", n)
+                except Exception:
+                    pass
             for w in list(self.workers.values()):
                 if w.state == "dead":
                     continue

@@ -77,6 +77,9 @@ class _Lib:
                                          ctypes.c_int64]
                 lib.ts_state.restype = ctypes.c_int
                 lib.ts_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+                lib.ts_reap_creating.restype = ctypes.c_int
+                lib.ts_reap_creating.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_uint64]
                 lib.ts_xfer_serve_start.restype = ctypes.c_int
                 lib.ts_xfer_serve_start.argtypes = [
                     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
@@ -172,6 +175,13 @@ class SharedMemoryStore:
         if not self._h:
             return 0
         return int(self._lib.ts_state(self._h, oid.binary()))
+
+    def reap_creating(self, max_age_s: float) -> int:
+        """Free kCreating entries orphaned by a dead producer; returns
+        the count freed."""
+        if not self._h:
+            return 0
+        return int(self._lib.ts_reap_creating(self._h, int(max_age_s)))
 
     def delete(self, oid: ObjectID) -> None:
         if not self._h:

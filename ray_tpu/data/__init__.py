@@ -12,7 +12,8 @@ twist is at the edge: `DataIterator.iter_device_batches` double-buffers
 jax.device_put so the input pipeline overlaps the SPMD step (SURVEY.md §7.7).
 """
 
-from ray_tpu.data.dataset import (Dataset, DataIterator, from_arrow,
+from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
+                                  DataIterator, from_arrow,
                                   from_items, from_numpy, from_pandas,
                                   range as range_, read_binary_files,
                                   read_csv, read_images, read_json,
@@ -25,6 +26,7 @@ from ray_tpu.data.grouped import GroupedData
 range = range_
 
 __all__ = [
+    "ActorPoolStrategy",
     "Dataset", "DataIterator", "from_arrow", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv", "read_images",
     "read_json", "read_parquet", "read_sql", "read_text", "read_tfrecords",

@@ -97,9 +97,38 @@ def _transform_block(block: Block, ops: List[tuple]) -> Block:
     return block
 
 
+def _apply_rebatched(fn, block: Block, bs: Optional[int]) -> Block:
+    """Run fn over bs-row slices of the block and concat (shared by the
+    task and actor-pool map_batches paths)."""
+    if bs is None:
+        return fn(block)
+    n = _block_rows(block)
+    outs = [fn(_block_slice(block, lo, min(lo + bs, n)))
+            for lo in builtins.range(0, n, bs)]
+    return _block_concat(outs)
+
+
+class ActorPoolStrategy:
+    """Stateful-actor compute for map_batches (ref: ActorPoolStrategy in
+    data/_internal/compute.py). size actors each construct the UDF class
+    once and stream blocks through it."""
+
+    def __init__(self, size: int = 2, *, num_cpus_per_actor: float = 0.5,
+                 min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        # min_size/max_size accepted for reference-API compatibility;
+        # the pool is fixed-size (autoscaling pools are a later round)
+        self.size = max_size or size
+        self.num_cpus_per_actor = num_cpus_per_actor
+
+
 class Dataset:
     """Immutable, lazy. Transformations append ops; execution happens on
-    iteration/materialize via remote tasks over blocks."""
+    iteration/materialize via remote tasks over blocks. Exception:
+    actor-pool map_batches stages (compute=ActorPoolStrategy / class
+    UDFs) execute EAGERLY at call time — the pool's lifetime must bracket
+    the pass (same shape as the reference's materialize-on-actor-pool
+    paths)."""
 
     def __init__(self, block_refs: List[Any], ops: Optional[List[tuple]] = None):
         self._block_refs = block_refs
@@ -108,22 +137,65 @@ class Dataset:
     # ---- transformations (lazy) -------------------------------------------
 
     def map_batches(self, fn: Callable[[Block], Block], *,
-                    batch_size: Optional[int] = None) -> "Dataset":
+                    batch_size: Optional[int] = None,
+                    compute: Optional["ActorPoolStrategy"] = None,
+                    fn_constructor_args: tuple = ()) -> "Dataset":
         """batch_size re-slices each block before fn (ref: dataset.py:385
         map_batches(batch_size=...) — bounds the UDF's working set, e.g.
-        a model's device batch)."""
+        a model's device batch). A CLASS fn (or compute=
+        ActorPoolStrategy(...)) runs on a pool of stateful actors so
+        expensive setup — loading a model to the device — happens once
+        per actor, not once per block (ref:
+        _internal/execution/operators/actor_pool_map_operator.py)."""
+        if compute is not None or isinstance(fn, type):
+            return self._map_batches_actors(
+                fn, batch_size, compute or ActorPoolStrategy(),
+                fn_constructor_args)
         if batch_size is None:
             return Dataset(self._block_refs,
                            self._ops + [("map_batches", fn)])
+        return Dataset(
+            self._block_refs,
+            self._ops + [("map_batches",
+                          lambda b: _apply_rebatched(fn, b, batch_size))])
 
-        def rebatched(block):
-            n = _block_rows(block)
-            outs = [fn(_block_slice(block, lo, min(lo + batch_size, n)))
-                    for lo in builtins.range(0, n, batch_size)]
-            return _block_concat(outs)
+    def _map_batches_actors(self, fn_cls, batch_size, strategy,
+                            ctor_args) -> "Dataset":
+        """Dispatch blocks over a pool of stateful map actors; blocks
+        travel as refs (never through the driver); actors are reaped
+        after the last block lands."""
+        import ray_tpu
 
-        return Dataset(self._block_refs,
-                       self._ops + [("map_batches", rebatched)])
+        if not isinstance(fn_cls, type):
+            raise TypeError(
+                "compute=ActorPoolStrategy(...) needs a callable CLASS "
+                "(stateful UDF with __call__), got a function")
+        upstream = self.materialize() if self._ops else self
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self, cls, args):
+                self.fn = cls(*args)
+
+            def apply(self, block, bs):
+                return _apply_rebatched(self.fn, block, bs)
+
+        n_actors = max(1, min(strategy.size, len(upstream._block_refs)))
+        pool = [_MapWorker.options(
+                    num_cpus=strategy.num_cpus_per_actor).remote(
+                    fn_cls, tuple(ctor_args))
+                for _ in builtins.range(n_actors)]
+        try:
+            refs = [pool[i % n_actors].apply.remote(ref, batch_size)
+                    for i, ref in enumerate(upstream._block_refs)]
+            ray_tpu.wait(refs, num_returns=len(refs))
+        finally:
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        return Dataset(refs, [])
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         return Dataset(self._block_refs, self._ops + [("map", fn)])

@@ -41,6 +41,7 @@
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -66,6 +67,7 @@ struct Entry {
   uint64_t capacity;  // allocated block size (>= size)
   int64_t refcount;   // pin count; evictable iff 0 and sealed
   uint64_t lru_tick;
+  uint64_t create_ts;  // wall seconds at kCreating entry; orphan reaping
 };
 
 struct FreeBlock {
@@ -380,6 +382,7 @@ uint64_t ts_create_buf(void* sp, const uint8_t* id, uint64_t size) {
   memcpy(e->id, id, kIdLen);
   e->state = kCreating;
   e->pending_delete = 0;
+  e->create_ts = (uint64_t)time(nullptr);
   e->offset = off;
   e->size = size;
   e->capacity = size;
@@ -616,6 +619,33 @@ uint64_t ts_num_evictions(void* sp) {
 // offsets (the native transfer plane in xfer.cc reads/writes the heap
 // directly: shm -> socket with no userspace staging buffer).
 void* ts_seg_base(void* sp) { return reinterpret_cast<Store*>(sp)->base; }
+
+// Reap kCreating entries older than max_age_s: a producer SIGKILLed
+// mid-write leaves its buffer orphaned forever (nothing seals or aborts
+// it), making the object permanently unfetchable on this node. Live
+// writers are safe at sane ages — local writes finish in seconds and
+// the transfer plane's socket timeout (120s) bounds remote ones.
+// Returns the number of entries freed.
+int ts_reap_creating(void* sp, uint64_t max_age_s) {
+  Store* s = reinterpret_cast<Store*>(sp);
+  Header* h = s->hdr;
+  if (lock(h) != 0) return 0;
+  uint64_t now = (uint64_t)time(nullptr);
+  Entry* tab = entries(h);
+  int n = 0;
+  for (uint32_t i = 0; i < h->max_objects; i++) {
+    Entry* e = &tab[i];
+    if (e->state == kCreating && e->create_ts + max_age_s <= now) {
+      heap_free(h, e->offset, e->capacity);
+      e->state = kFree;
+      e->pending_delete = 0;
+      h->num_objects--;
+      n++;
+    }
+  }
+  unlock(h);
+  return n;
+}
 
 // Entry state probe: 0 = absent, 1 = creating (a racing producer/puller
 // is mid-write), 2 = sealed. Lets the transfer plane distinguish

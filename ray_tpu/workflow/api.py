@@ -125,6 +125,8 @@ def run_async(node: StepNode, *, workflow_id: str, storage: str):
     fut: Future = Future()
 
     def work():
+        if not fut.set_running_or_notify_cancel():
+            return    # cancelled before the workflow started
         try:
             fut.set_result(run(node, workflow_id=workflow_id,
                                storage=storage))

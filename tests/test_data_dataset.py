@@ -503,3 +503,45 @@ def test_iter_torch_batches(ray_start_regular):
     b = next(ds.iter_torch_batches(batch_size=8,
                                    dtypes={"id": torch.float64}))
     assert b["id"].dtype == torch.float64
+
+
+def test_map_batches_actor_pool(ray_start_regular):
+    """Stateful class UDF over an actor pool: construction happens once
+    per actor, not per block (ref: actor_pool_map_operator.py)."""
+    import os
+
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    class AddModelBias:
+        def __init__(self, bias):
+            self.bias = bias          # "expensive model load"
+            self.pid = os.getpid()
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.bias,
+                    "pid": np.full(len(batch["id"]), self.pid)}
+
+    ds = data.range(64, num_blocks=8).map_batches(
+        AddModelBias, compute=ActorPoolStrategy(size=2),
+        fn_constructor_args=(1000,))
+    rows = ds.take_all()
+    assert len(rows) == 64
+    assert sorted(r["id"] for r in rows) == list(range(1000, 1064))
+    # 8 blocks ran on exactly 2 actor processes
+    assert len({int(r["pid"]) for r in rows}) == 2
+
+
+def test_map_batches_actor_pool_after_lazy_ops(ray_start_regular):
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    class Square:
+        def __call__(self, batch):
+            return {"id": batch["id"] ** 2}
+
+    ds = (data.range(20, num_blocks=4)
+          .filter(lambda r: r["id"] % 2 == 0)
+          .map_batches(Square, compute=ActorPoolStrategy(size=1)))
+    assert sorted(r["id"] for r in ds.take_all()) == [
+        (2 * i) ** 2 for i in range(10)]

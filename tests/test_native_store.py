@@ -100,3 +100,27 @@ def test_xfer_delete_race_keeps_stream_intact(stores):
         b.release(oid)
     else:                            # delete won before the pin landed
         assert results["rc"] == 1
+
+
+def test_reap_orphaned_creating_entries(stores):
+    """A producer that dies mid-write leaves kCreating forever; the
+    reaper frees it (age 0 here) so the id becomes creatable again."""
+    a, _ = stores
+    oid = ObjectID.from_random()
+    view = a.create_view(oid, 2048)
+    del view               # producer "dies": no seal, no abort
+    assert a.state(oid) == 1
+    assert a.create_view(oid, 2048) is None   # id blocked by the orphan
+    assert a.reap_creating(0) == 1
+    assert a.state(oid) == 0
+    v2 = a.create_view(oid, 2048)              # creatable again
+    assert v2 is not None
+    del v2
+    a.seal(oid)
+    assert a.contains(oid)
+    # a live (young) creating entry is NOT reaped at a sane age
+    oid2 = ObjectID.from_random()
+    v3 = a.create_view(oid2, 64)
+    assert a.reap_creating(300) == 0
+    del v3
+    a.abort(oid2)
