@@ -99,7 +99,11 @@ def _transform_block(block: Block, ops: List[tuple]) -> Block:
 
 def _apply_rebatched(fn, block: Block, bs: Optional[int]) -> Block:
     """Run fn over bs-row slices of the block and concat (shared by the
-    task and actor-pool map_batches paths)."""
+    task and actor-pool map_batches paths). Empty blocks (e.g. a filter
+    matched nothing) skip the UDF — they also lose their column schema,
+    so calling fn would hand it a bare list."""
+    if _block_rows(block) == 0:
+        return block
     if bs is None:
         return fn(block)
     n = _block_rows(block)
@@ -170,24 +174,28 @@ class Dataset:
             raise TypeError(
                 "compute=ActorPoolStrategy(...) needs a callable CLASS "
                 "(stateful UDF with __call__), got a function")
-        upstream = self.materialize() if self._ops else self
+        # pending lazy ops fuse INTO the actor (one hop per block, no
+        # intermediate materialize through the store)
+        pending_ops = self._ops
 
         @ray_tpu.remote
         class _MapWorker:
-            def __init__(self, cls, args):
+            def __init__(self, cls, args, ops):
                 self.fn = cls(*args)
+                self.ops = ops
 
             def apply(self, block, bs):
+                block = _transform_block(block, self.ops)
                 return _apply_rebatched(self.fn, block, bs)
 
-        n_actors = max(1, min(strategy.size, len(upstream._block_refs)))
+        n_actors = max(1, min(strategy.size, len(self._block_refs)))
         pool = [_MapWorker.options(
                     num_cpus=strategy.num_cpus_per_actor).remote(
-                    fn_cls, tuple(ctor_args))
+                    fn_cls, tuple(ctor_args), pending_ops)
                 for _ in builtins.range(n_actors)]
         try:
             refs = [pool[i % n_actors].apply.remote(ref, batch_size)
-                    for i, ref in enumerate(upstream._block_refs)]
+                    for i, ref in enumerate(self._block_refs)]
             ray_tpu.wait(refs, num_returns=len(refs))
         finally:
             for a in pool:

@@ -49,7 +49,10 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5250555453544F52ULL;  // "RPUTSTOR"
+// Layout version is baked into the magic: bump the low byte whenever
+// Entry/Header change so a process built against a different layout
+// fails attach instead of silently corrupting a live segment.
+constexpr uint64_t kMagic = 0x5250555453544F02ULL;  // "RPUTSTO" + v2
 constexpr uint32_t kIdLen = 20;
 
 enum ObjState : uint32_t {
@@ -645,6 +648,23 @@ int ts_reap_creating(void* sp, uint64_t max_age_s) {
   }
   unlock(h);
   return n;
+}
+
+// Heartbeat a kCreating entry: a long-running writer (the transfer
+// plane's chunked receive) refreshes create_ts so the orphan reaper
+// never frees a buffer that is actively receiving bytes.
+int ts_touch_creating(void* sp, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(sp);
+  Header* h = s->hdr;
+  if (lock(h) != 0) return -1;
+  Entry* e = find_slot(h, id, false);
+  int r = -1;
+  if (e != nullptr && e->state == kCreating) {
+    e->create_ts = (uint64_t)time(nullptr);
+    r = 0;
+  }
+  unlock(h);
+  return r;
 }
 
 // Entry state probe: 0 = absent, 1 = creating (a racing producer/puller

@@ -48,6 +48,7 @@ int ts_seal(void* sp, const uint8_t* id);
 int ts_abort(void* sp, const uint8_t* id);
 void* ts_seg_base(void* sp);
 int ts_state(void* sp, const uint8_t* id);
+int ts_touch_creating(void* sp, const uint8_t* id);
 }
 
 namespace {
@@ -241,10 +242,20 @@ int ts_xfer_fetch(void* store, const char* host, int port,
     return ts_state(store, id) != 0 ? 5 : 3;
   }
   uint8_t* dst = reinterpret_cast<uint8_t*>(ts_seg_base(store)) + off;
-  if (!read_exact(fd, dst, total)) {
-    ts_abort(store, id);
-    close(fd);
-    return 4;
+  // chunked receive with a heartbeat per chunk: a slow multi-GB pull
+  // streams continuously but can outlive the orphan-reaper age; the
+  // touch keeps the kCreating entry visibly alive while bytes flow
+  uint64_t got = 0;
+  while (got < total) {
+    uint64_t chunk = total - got > (64ULL << 20) ? (64ULL << 20)
+                                                 : total - got;
+    if (!read_exact(fd, dst + got, chunk)) {
+      ts_abort(store, id);
+      close(fd);
+      return 4;
+    }
+    got += chunk;
+    ts_touch_creating(store, id);
   }
   close(fd);
   ts_seal(store, id);

@@ -1,0 +1,65 @@
+"""Serve throughput/latency workload (ref: release/serve_tests/workloads/
+serve_micro_benchmark.py — qps + latency percentiles on a noop and a
+compute deployment).
+
+Run: python release/serve_benchmark.py [--requests 2000]
+Prints one JSON line per scenario.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def bench(handle, n, concurrency=32):
+    lat = []
+    t0 = time.time()
+    inflight = []
+    for i in range(n):
+        inflight.append((time.time(), handle.remote(i)))
+        if len(inflight) >= concurrency:
+            ts, ref = inflight.pop(0)
+            ray_tpu.get(ref)
+            lat.append(time.time() - ts)
+    for ts, ref in inflight:
+        ray_tpu.get(ref)
+        lat.append(time.time() - ts)
+    dt = time.time() - t0
+    lat_ms = np.asarray(lat) * 1000
+    return {"qps": round(n / dt, 1),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "p95_ms": round(float(np.percentile(lat_ms, 95)), 2),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 2)}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=2000)
+    p.add_argument("--address", default=None)
+    args = p.parse_args()
+    if args.address:
+        ray_tpu.init(address=args.address)
+    else:
+        ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+
+    @serve.deployment(num_replicas=2,
+                      ray_actor_options={"num_cpus": 0.5})
+    class Noop:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Noop.bind())
+    ray_tpu.get(h.remote(0))  # warm replicas
+    out = bench(h, args.requests)
+    print(json.dumps({"scenario": "noop_2replica", **out}))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

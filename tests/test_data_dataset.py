@@ -545,3 +545,20 @@ def test_map_batches_actor_pool_after_lazy_ops(ray_start_regular):
           .map_batches(Square, compute=ActorPoolStrategy(size=1)))
     assert sorted(r["id"] for r in ds.take_all()) == [
         (2 * i) ** 2 for i in range(10)]
+
+
+def test_map_batches_actor_pool_empty_block(ray_start_regular):
+    """A block fully emptied by an upstream filter skips the UDF
+    (regression: the empty block loses its schema and arrives as [])."""
+    from ray_tpu import data
+    from ray_tpu.data import ActorPoolStrategy
+
+    class Add5:
+        def __call__(self, b):
+            return {"id": b["id"] + 5}
+
+    out = (data.range(30, num_blocks=3)
+           .filter(lambda r: r["id"] < 20)   # third block -> empty
+           .map_batches(Add5, compute=ActorPoolStrategy(size=2))
+           .take_all())
+    assert sorted(r["id"] for r in out) == [i + 5 for i in range(20)]
