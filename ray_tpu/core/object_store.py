@@ -105,6 +105,16 @@ class SharedMemoryStore:
             self._h = self._lib.ts_create(cname, capacity, max_objects)
         else:
             self._h = self._lib.ts_attach(cname)
+            if not self._h:
+                # transient insurance: creator publishes the magic last,
+                # so an attach racing the tail of creation can miss it
+                import time as _time
+
+                for _ in range(20):
+                    _time.sleep(0.05)
+                    self._h = self._lib.ts_attach(cname)
+                    if self._h:
+                        break
         if not self._h:
             raise RuntimeError(f"object store {'create' if create else 'attach'} failed: {name}")
         total = self._lib.ts_total_size(self._h)

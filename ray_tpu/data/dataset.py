@@ -53,6 +53,11 @@ def _block_concat(blocks: List[Block]) -> Block:
 def _apply_op(block: Block, op: tuple) -> Block:
     kind, fn = op[0], op[1]
     if kind == "map_batches":
+        # empty blocks skip the UDF on EVERY path: a fully-filtered
+        # tabular block degrades to [] (schema lost), which a column-
+        # addressing UDF cannot handle
+        if _block_rows(block) == 0:
+            return block
         return fn(block)
     if kind == "map":
         if isinstance(block, dict):
@@ -349,6 +354,59 @@ class Dataset:
         for i, ref in enumerate(self._block_refs):
             parts[i % n].append(ref)
         return [Dataset(p, list(self._ops)) for p in parts]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Row-exact split at sorted global indices (ref:
+        dataset.split_at_indices)."""
+        import ray_tpu
+
+        if any(i < 0 for i in indices) or list(indices) != sorted(indices):
+            raise ValueError(
+                f"indices must be non-negative and sorted, got {indices}")
+        whole = _block_concat(list(self._iter_blocks()))
+        n = _block_rows(whole)
+        bounds = [0] + [min(i, n) for i in indices] + [n]
+        out = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            out.append(Dataset([ray_tpu.put(_block_slice(whole, lo, hi))],
+                               []))
+        return out
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> "tuple[Dataset, Dataset]":
+        """(train, test) row split (ref: dataset.train_test_split)."""
+        if not 0 < test_size < 1:
+            raise ValueError("test_size must be in (0, 1)")
+        # materialize once: count() + split would otherwise execute the
+        # pending op pipeline twice (and disagree under nondeterminism)
+        ds = (self.random_shuffle(seed=seed) if shuffle
+              else self).materialize()
+        n = ds.count()
+        cut = n - int(n * test_size)
+        train, test = ds.split_at_indices([cut])
+        return train, test
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (ref: dataset.unique). Per-block
+        np.unique runs in the transform tasks; only the small distinct
+        sets reach the driver (same shape as preprocessors'
+        _distributed_unique)."""
+        def per_block(block):
+            col = (block[column] if isinstance(block, dict)
+                   else [r[column] for r in block])
+            return {column: np.unique(np.asarray(col).reshape(-1))}
+
+        seen: set = set()
+        for block in self.map_batches(per_block)._iter_blocks():
+            for v in block[column]:
+                seen.add(v.item() if hasattr(v, "item") else v)
+        return sorted(seen)
+
+    def show(self, limit: int = 20) -> None:
+        """Print the first rows (ref: dataset.show)."""
+        for r in self.take(limit):
+            print(r)
 
     def streaming_split(self, n: int) -> List["DataIterator"]:
         """Per-rank iterators for train ingest (ref:

@@ -255,7 +255,13 @@ int ts_xfer_fetch(void* store, const char* host, int port,
       return 4;
     }
     got += chunk;
-    ts_touch_creating(store, id);
+    if (ts_touch_creating(store, id) != 0) {
+      // entry vanished mid-fetch (reaped as an orphan after a long
+      // stall, or deleted): the buffer may already be reallocated —
+      // stop writing and DO NOT seal a foreign entry
+      close(fd);
+      return 4;
+    }
   }
   close(fd);
   ts_seal(store, id);

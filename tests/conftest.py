@@ -51,3 +51,27 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+
+def pytest_sessionstart(session):
+    """Remove object-store segments leaked by previous runs' SIGKILLed
+    daemons (chaos tests): stale /dev/shm entries accumulate across
+    sessions and can pressure tmpfs during the suite. Only reaps
+    test-prefixed segments plus raytpu_* ones idle for over an hour, so
+    a LIVE non-test cluster on the same machine is never touched."""
+    import glob
+    import os
+    import time
+
+    now = time.time()
+    for p in glob.glob("/dev/shm/rtx_test_*"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    for p in glob.glob("/dev/shm/raytpu_*") + glob.glob("/dev/shm/rtx_*"):
+        try:
+            if now - os.path.getmtime(p) > 3600:
+                os.unlink(p)
+        except OSError:
+            pass

@@ -562,3 +562,52 @@ def test_map_batches_actor_pool_empty_block(ray_start_regular):
            .map_batches(Add5, compute=ActorPoolStrategy(size=2))
            .take_all())
     assert sorted(r["id"] for r in out) == [i + 5 for i in range(20)]
+
+
+def test_split_at_indices_and_train_test_split(ray_start_regular):
+    from ray_tpu import data
+
+    parts = data.range(100).split_at_indices([30, 80])
+    assert [p.count() for p in parts] == [30, 50, 20]
+    assert parts[1].take(1)[0]["id"] == 30
+
+    train, test = data.range(50).train_test_split(0.2)
+    assert train.count() == 40 and test.count() == 10
+    train, test = data.range(50).train_test_split(0.2, shuffle=True, seed=7)
+    assert train.count() == 40 and test.count() == 10
+    ids = {r["id"] for r in train.take_all()} | {
+        r["id"] for r in test.take_all()}
+    assert ids == set(range(50))
+
+
+def test_unique_and_show(ray_start_regular, capsys):
+    from ray_tpu import data
+
+    ds = data.from_items([{"c": i % 3} for i in range(30)], num_blocks=3)
+    assert ds.unique("c") == [0, 1, 2]
+    ds.show(2)
+    out = capsys.readouterr().out
+    assert out.count("\n") == 2
+
+
+def test_map_batches_empty_block_task_path(ray_start_regular):
+    """Empty-block UDF skip on the plain task path too (the guard lives
+    in _apply_op, not only the actor path)."""
+    from ray_tpu import data
+
+    out = (data.range(30, num_blocks=3)
+           .filter(lambda r: r["id"] < 20)
+           .map_batches(lambda b: {"id": b["id"] + 5})
+           .take_all())
+    assert sorted(r["id"] for r in out) == [i + 5 for i in range(20)]
+
+
+def test_split_at_indices_validates(ray_start_regular):
+    from ray_tpu import data
+
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="sorted"):
+        data.range(10).split_at_indices([8, 3])
+    with _pt.raises(ValueError, match="non-negative"):
+        data.range(10).split_at_indices([-1])
