@@ -399,6 +399,10 @@ class Dataset:
 
         seen: set = set()
         for block in self.map_batches(per_block)._iter_blocks():
+            # blocks fully emptied by an upstream filter pass through
+            # _apply_op untransformed as schemaless [] — nothing to add
+            if _block_rows(block) == 0:
+                continue
             for v in block[column]:
                 seen.add(v.item() if hasattr(v, "item") else v)
         return sorted(seen)

@@ -16,6 +16,19 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 
 
+def _content_bytes(a: Any) -> bytes:
+    """Stable content bytes of a step arg. Plain pickle first; callables
+    and anything else plain pickle rejects (lambdas, __main__ closures)
+    fall back to cloudpickle, which is what actually ships args to the
+    executing task."""
+    try:
+        return pickle.dumps(a, protocol=4)
+    except Exception:
+        import cloudpickle
+
+        return cloudpickle.dumps(a, protocol=4)
+
+
 class StepNode:
     def __init__(self, fn, args, kwargs, name=None, max_retries: int = 3):
         self.fn = fn
@@ -25,15 +38,20 @@ class StepNode:
         self.max_retries = max_retries
 
     def key(self) -> str:
+        # Content-address by the *pickled* args, not repr(): numpy reprs
+        # elide interior elements, so two different large arrays would
+        # collide onto one step key and resume would silently return the
+        # wrong cached result (ref checkpoint identity:
+        # python/ray/workflow/task_executor.py).
         h = hashlib.sha1(self.name.encode())
         for a in self.args:
             h.update(a.key().encode() if isinstance(a, StepNode)
-                     else repr(a).encode())
+                     else _content_bytes(a))
         for k in sorted(self.kwargs):
             v = self.kwargs[k]
             h.update(k.encode())
             h.update(v.key().encode() if isinstance(v, StepNode)
-                     else repr(v).encode())
+                     else _content_bytes(v))
         return f"{self.name}-{h.hexdigest()[:16]}"
 
 

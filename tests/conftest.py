@@ -53,25 +53,49 @@ except Exception:
     pass
 
 
+def _shm_segments_in_use():
+    """Names of /dev/shm segments currently mmap'd by any live process.
+
+    mtime is NOT a liveness signal — writes through an existing mmap do
+    not reliably update it — so a healthy long-running cluster could look
+    'idle for an hour'. /proc/*/maps lists the backing file of every
+    mapping, which is authoritative.
+    """
+    import glob
+
+    used = set()
+    for maps in glob.glob("/proc/[0-9]*/maps"):
+        try:
+            with open(maps) as f:
+                for line in f:
+                    i = line.find("/dev/shm/")
+                    if i >= 0:
+                        used.add(line[i:].split()[0])
+        except OSError:
+            continue
+    return used
+
+
 def pytest_sessionstart(session):
     """Remove object-store segments leaked by previous runs' SIGKILLed
     daemons (chaos tests): stale /dev/shm entries accumulate across
-    sessions and can pressure tmpfs during the suite. Only reaps
-    test-prefixed segments plus raytpu_* ones idle for over an hour, so
-    a LIVE non-test cluster on the same machine is never touched."""
+    sessions and can pressure tmpfs during the suite. A segment is only
+    reaped if NO live process maps it (checked via /proc/*/maps) and it
+    is past a short creation grace period, so a LIVE cluster on the same
+    machine is never touched."""
     import glob
     import os
     import time
 
     now = time.time()
-    for p in glob.glob("/dev/shm/rtx_test_*"):
-        try:
-            os.unlink(p)
-        except OSError:
-            pass
+    in_use = _shm_segments_in_use()
     for p in glob.glob("/dev/shm/raytpu_*") + glob.glob("/dev/shm/rtx_*"):
+        if p in in_use:
+            continue
         try:
-            if now - os.path.getmtime(p) > 3600:
+            # grace period covers the shm_open -> mmap window of a
+            # just-starting store
+            if now - os.path.getmtime(p) > 60:
                 os.unlink(p)
         except OSError:
             pass

@@ -590,6 +590,15 @@ def test_unique_and_show(ray_start_regular, capsys):
     assert out.count("\n") == 2
 
 
+def test_unique_after_emptying_filter(ray_start_regular):
+    """unique() must skip blocks fully emptied by an upstream filter —
+    they pass through as schemaless [] (regression for ADVICE r1)."""
+    from ray_tpu import data
+
+    ds = data.from_items([{"c": i} for i in range(30)], num_blocks=3)
+    assert ds.filter(lambda r: r["c"] < 20).unique("c") == list(range(20))
+
+
 def test_map_batches_empty_block_task_path(ray_start_regular):
     """Empty-block UDF skip on the plain task path too (the guard lives
     in _apply_op, not only the actor path)."""
