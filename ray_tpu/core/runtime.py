@@ -72,7 +72,7 @@ class _ObjectEntry:
     """Owner-side directory entry (ref: ObjectDirectory + memory store)."""
 
     __slots__ = ("state", "inline", "locations", "error", "event", "spec",
-                 "size", "primaries")
+                 "size", "primaries", "waiters")
 
     def __init__(self):
         self.state = "pending"        # pending | ready | error | lost
@@ -86,6 +86,11 @@ class _ObjectEntry:
         self.event = threading.Event()
         self.spec: Optional[TaskSpec] = None   # lineage for reconstruction
         self.size = 0                 # stored bytes (locality scheduling)
+        # completion callbacks (ref: wait_manager.h WaitRequest — waits
+        # are notified, never polled). Persistent: they survive an
+        # event.clear() on lineage reconstruction and fire again at the
+        # next completion; registrants remove them when done.
+        self.waiters: List[Any] = []
 
 
 class _LeasedWorker:
@@ -333,7 +338,7 @@ class Runtime:
             e.primaries.add(self.nodelet_addr)
             e.size = size
         e.state = "ready"
-        e.event.set()
+        self._complete_entry(e)
         return ObjectRef(oid, self.address)
 
     def _pin_primary(self, oid: ObjectID):
@@ -675,41 +680,162 @@ class Runtime:
         return self._get_one(ref, deadline, _depth + 1)
 
     # --- wait ---------------------------------------------------------------
+    # Event-driven (ref: src/ray/raylet/wait_manager.h): completions
+    # notify registered waiters; nothing polls. Owned refs subscribe to
+    # their directory entry in-process (zero RPCs); borrowed refs get one
+    # long-lived wait_object watcher coroutine at the owner instead of a
+    # locate RPC per 5 ms tick.
+
+    def _entry_subscribe(self, e: _ObjectEntry, cb) -> bool:
+        """Register a persistent completion callback. Returns True when
+        the entry is already complete (callers must then check state
+        themselves — the callback is NOT invoked retroactively)."""
+        with self._dir_lock:
+            e.waiters.append(cb)
+        return e.event.is_set()
+
+    def _entry_unsubscribe(self, e: _ObjectEntry, cb):
+        with self._dir_lock:
+            try:
+                e.waiters.remove(cb)
+            except ValueError:
+                pass
+
+    def _complete_entry(self, e: _ObjectEntry):
+        """Single completion choke point: set the threading event for
+        blocking getters, then fire waiter callbacks (outside the lock —
+        callbacks may re-enter runtime methods)."""
+        e.event.set()
+        with self._dir_lock:
+            waiters = list(e.waiters)
+        for cb in waiters:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    async def _await_entry(self, e: _ObjectEntry,
+                           timeout: Optional[float] = None) -> bool:
+        """Await entry completion on the runtime loop without burning an
+        executor thread. Returns completion status at exit."""
+        if e.event.is_set():
+            return True
+        fut = self.loop.create_future()
+
+        def _cb():
+            try:
+                self.loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(None))
+            except RuntimeError:
+                pass  # loop shut down
+
+        already = self._entry_subscribe(e, _cb)
+        try:
+            if already:
+                return True
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                pass
+            return e.event.is_set()
+        finally:
+            self._entry_unsubscribe(e, _cb)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-        """ref: worker.py:2582 / CoreWorker::Wait."""
-        deadline = None if timeout is None else time.time() + timeout
-        pending = list(refs)
-        ready: List[ObjectRef] = []
-        while len(ready) < num_returns:
-            still = []
-            for r in pending:
-                if self._is_ready(r):
-                    ready.append(r)
-                else:
-                    still.append(r)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.time() >= deadline:
-                break
-            time.sleep(0.005)
-        return ready, pending
-
-    def _is_ready(self, ref: ObjectRef) -> bool:
-        oid = ref.id
-        if self.memory_store.contains(oid) or self.store.contains(oid):
-            return True
-        if self.refs.is_owned(oid):
-            e = self._entry(oid)
-            return e.state in ("ready", "error")
+        """ref: worker.py:2582 / CoreWorker::Wait + raylet wait_manager.h."""
+        # Blocking on kick.wait from the loop thread would freeze the very
+        # loop that delivers completions — fail loudly, like _run does.
         try:
-            r = self._run(self.pool.get(ref.owner.addr).call(
-                "locate", oid=oid, timeout=5.0))
-            return r["status"] in ("ready", "error")
-        except Exception:
-            return False
+            on_loop = asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            raise RuntimeError(
+                "Runtime.wait called from the event-loop thread; this "
+                "would deadlock — move the call to an executor thread")
+        deadline = None if timeout is None else time.time() + timeout
+        kick = threading.Event()
+        completed: Set[bytes] = set()   # oid.binary set by notifications
+        stop = [False]                  # watcher stop flag (closure cell)
+        subs: List[Tuple[_ObjectEntry, Any]] = []
+        watchers: List[Any] = []
+        ready: List[ObjectRef] = []
+        pending: List[ObjectRef] = []
+
+        def _owned_ready(e: _ObjectEntry) -> bool:
+            # "lost" completes the event but is not claimable by wait()
+            return e.event.is_set() and e.state in ("ready", "error")
+
+        async def _watch_borrowed(ref: ObjectRef):
+            """One watcher per borrowed pending ref: park in the owner's
+            wait_object (server blocks up to wait_timeout per call) until
+            a claimable status arrives."""
+            oid = ref.id
+            while not stop[0] and not self._shutdown:
+                try:
+                    r = await self.pool.get(ref.owner.addr).call(
+                        "wait_object", oid=oid, wait_timeout=5.0,
+                        timeout=15.0)
+                except Exception:
+                    await asyncio.sleep(1.0)   # owner unreachable: retry
+                    continue
+                if r["status"] in ("ready", "error"):
+                    completed.add(oid.binary())
+                    kick.set()
+                    return
+                if r["status"] == "lost":
+                    # may be revived by lineage reconstruction — keep
+                    # watching, but don't hot-loop on a terminal state
+                    await asyncio.sleep(1.0)
+
+        for r in refs:
+            oid = r.id
+            if self.memory_store.contains(oid) or self.store.contains(oid):
+                ready.append(r)
+                continue
+            if self.refs.is_owned(oid):
+                e = self._entry(oid)
+                cb = (lambda b=oid.binary(): (completed.add(b), kick.set()))
+                self._entry_subscribe(e, cb)
+                subs.append((e, cb))
+                if _owned_ready(e):
+                    ready.append(r)
+                    continue
+            else:
+                watchers.append(asyncio.run_coroutine_threadsafe(
+                    _watch_borrowed(r), self.loop))
+            pending.append(r)
+
+        try:
+            while len(ready) < num_returns and pending:
+                kick.clear()
+                if completed:
+                    done_now = set(completed)
+                    still = []
+                    for r in pending:
+                        if r.id.binary() in done_now:
+                            claim = (_owned_ready(self._entry(r.id))
+                                     if self.refs.is_owned(r.id) else True)
+                            if claim:
+                                ready.append(r)
+                                continue
+                            completed.discard(r.id.binary())
+                        still.append(r)
+                    pending = still
+                if len(ready) >= num_returns or not pending:
+                    break
+                rem = None if deadline is None else deadline - time.time()
+                if rem is not None and rem <= 0:
+                    break
+                kick.wait(rem)
+        finally:
+            stop[0] = True
+            for e, cb in subs:
+                self._entry_unsubscribe(e, cb)
+            for w in watchers:
+                w.cancel()
+        return ready, pending
 
     # ------------------------------------------------------ function shipping
 
@@ -868,8 +994,10 @@ class Runtime:
                                   pending: List[ObjectID]):
         for oid in pending:
             e = self._entry(oid)
+            # event-driven: a completion callback wakes us; the 1 s cap
+            # only bounds shutdown latency, there is no busy-poll
             while not e.event.is_set() and not self._shutdown:
-                await asyncio.sleep(0.005)
+                await self._await_entry(e, timeout=1.0)
         # Errored/lost deps still dispatch: the executing worker surfaces
         # the dependency failure as the task's error (same as the ref,
         # where the raylet cancels on dep failure and the owner raises).
@@ -1063,7 +1191,7 @@ class Runtime:
                 self.memory_store.put(rid, payload)
             if e.state != "error":
                 e.state = "ready"
-            e.event.set()
+            self._complete_entry(e)
         self._record_event(spec, "FAILED" if app_error else "FINISHED")
         self._inflight.pop(spec.task_id, None)
         arg_ids = [p[0] for (k, p) in spec.args if k == "ref"]
@@ -1078,7 +1206,7 @@ class Runtime:
             e = self._entry(rid)
             e.error = ser
             e.state = "error"
-            e.event.set()
+            self._complete_entry(e)
             self.memory_store.put(rid, ser)
         self._record_event(spec, "FAILED")
         self._inflight.pop(spec.task_id, None)
@@ -1308,8 +1436,9 @@ class Runtime:
 
     async def rpc_wait_object(self, oid: ObjectID, wait_timeout: float = 30.0) -> dict:
         e = self._entry(oid)
-        loop = asyncio.get_running_loop()
-        ok = await loop.run_in_executor(None, e.event.wait, wait_timeout)
+        # asyncio waiter, not run_in_executor(event.wait): thousands of
+        # concurrent borrower waits would exhaust the executor pool
+        ok = await self._await_entry(e, timeout=wait_timeout)
         if not ok:
             return {"status": "pending"}
         if e.state == "error":
@@ -1354,7 +1483,7 @@ class Runtime:
             return {"status": "has_copies"}
         if e.spec is None:
             e.state = "lost"
-            e.event.set()
+            self._complete_entry(e)
             return {"status": "unrecoverable"}
         if e.state != "pending":
             logger.warning("reconstructing %s via lineage "

@@ -36,13 +36,18 @@ class StepNode:
         self.kwargs = kwargs
         self.name = name or getattr(fn, "__name__", "step")
         self.max_retries = max_retries
+        self._key: Optional[str] = None
 
     def key(self) -> str:
         # Content-address by the *pickled* args, not repr(): numpy reprs
         # elide interior elements, so two different large arrays would
         # collide onto one step key and resume would silently return the
         # wrong cached result (ref checkpoint identity:
-        # python/ray/workflow/task_executor.py).
+        # python/ray/workflow/task_executor.py). Memoized — parents hash
+        # their children's keys, so an uncached chain would re-pickle
+        # large args once per ancestor.
+        if self._key is not None:
+            return self._key
         h = hashlib.sha1(self.name.encode())
         for a in self.args:
             h.update(a.key().encode() if isinstance(a, StepNode)
@@ -52,7 +57,8 @@ class StepNode:
             h.update(k.encode())
             h.update(v.key().encode() if isinstance(v, StepNode)
                      else _content_bytes(v))
-        return f"{self.name}-{h.hexdigest()[:16]}"
+        self._key = f"{self.name}-{h.hexdigest()[:16]}"
+        return self._key
 
 
 class _Step:

@@ -89,13 +89,21 @@ def pytest_sessionstart(session):
 
     now = time.time()
     in_use = _shm_segments_in_use()
+    for p in glob.glob("/dev/shm/rtx_test_*"):
+        if p not in in_use:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    # Non-test-prefixed segments keep the 1 h age guard ON TOP of the
+    # maps check: /proc can hide mappers (other PID namespaces sharing
+    # /dev/shm, hidepid mounts, EACCES on other users' maps), so the
+    # liveness check alone is not proof of abandonment.
     for p in glob.glob("/dev/shm/raytpu_*") + glob.glob("/dev/shm/rtx_*"):
         if p in in_use:
             continue
         try:
-            # grace period covers the shm_open -> mmap window of a
-            # just-starting store
-            if now - os.path.getmtime(p) > 60:
+            if now - os.path.getmtime(p) > 3600:
                 os.unlink(p)
         except OSError:
             pass
