@@ -39,7 +39,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu.core import runtime as _rt
 from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor, method
-from ray_tpu.core.common import ObjectRef, ResourceSet
+from ray_tpu.core.common import (ObjectRef, ObjectRefGenerator,
+                                 ResourceSet)
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import JobID
 from ray_tpu.core.node import (detect_tpu_chips, new_session_dir, start_gcs,
@@ -292,6 +293,6 @@ __all__ = [
     "init", "shutdown", "remote", "put", "get", "wait", "kill", "cancel",
     "method", "get_actor", "nodes", "cluster_resources", "available_resources",
     "timeline", "stack", "internal_stats",
-    "ObjectRef", "ActorHandle", "exceptions", "is_initialized",
+    "ObjectRef", "ObjectRefGenerator", "ActorHandle", "exceptions", "is_initialized",
     "__version__",
 ]

@@ -34,12 +34,19 @@ class ActorMethod:
                            num_returns if num_returns is not None else self._num_returns)
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.core.common import STREAMING
+
+        nr = self._num_returns
+        if nr in ("streaming", "dynamic"):
+            nr = STREAMING
         runtime = rt.get_runtime()
         refs = runtime.submit_actor_call(
             self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns,
+            num_returns=nr,
             max_task_retries=self._handle._max_task_retries)
-        if self._num_returns == 1:
+        if nr == STREAMING:
+            return refs   # an ObjectRefGenerator
+        if nr == 1:
             return refs[0]
         return refs
 

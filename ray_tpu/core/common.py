@@ -93,6 +93,86 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
 
+# num_returns sentinel for generator tasks whose return refs are created
+# incrementally as the executor yields (ref: task_manager.h:143-171
+# streaming-generator refs / num_returns="dynamic").
+STREAMING = -1
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's item refs, in yield order.
+
+    next() blocks until the executor has reported the next item to the
+    owner (or the stream ended: StopIteration, or errored: the task's
+    exception — after all successfully-yielded items were consumed, like
+    the reference's generator semantics). Only meaningful in the owning
+    process; pass individual item refs, not the generator, to other tasks.
+    """
+
+    def __init__(self, task_id, owner: RuntimeAddress):
+        self.task_id = task_id
+        self.owner = owner
+        self._index = 0
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from ray_tpu.core import runtime as rt
+
+        # next_stream_ref returns None on clean end-of-stream (StopIteration
+        # cannot ride through asyncio futures, so the sentinel keeps the
+        # sync and async paths on one runtime call)
+        ref = rt.get_runtime().next_stream_ref(self.task_id,
+                                               self._index + 1)
+        if ref is None:
+            raise StopIteration
+        self._index += 1
+        return ref
+
+    def __aiter__(self) -> "ObjectRefGenerator":
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio
+
+        from ray_tpu.core import runtime as rt
+
+        rt_ = rt.get_runtime()
+        ref = await asyncio.get_running_loop().run_in_executor(
+            None, rt_.next_stream_ref, self.task_id, self._index + 1)
+        if ref is None:
+            raise StopAsyncIteration
+        self._index += 1
+        return ref
+
+    def completed(self) -> int:
+        """Items reported so far (non-blocking)."""
+        from ray_tpu.core import runtime as rt
+
+        return rt.get_runtime().stream_progress(self.task_id)[0]
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator is only meaningful in the owning process; "
+            "pass the individual item refs instead")
+
+    def __del__(self):
+        # Discarding the generator releases a backpressure-blocked
+        # executor (its next report returns ok=False and it stops).
+        from ray_tpu.core import runtime as rt
+
+        r = rt.current_runtime_or_none()
+        if r is not None:
+            try:
+                r.drop_stream(self.task_id)
+            except Exception:
+                pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self.task_id.hex()}, next={self._index + 1})"
+
+
 def _deserialize_ref(oid: ObjectID, owner: RuntimeAddress) -> ObjectRef:
     return ObjectRef(oid, owner)
 
@@ -217,9 +297,19 @@ class TaskSpec:
     # tracing context {trace_id, span_id} (ref: tracing_helper.py
     # _function_hydrate_span_args — span context rides the task spec)
     trace_ctx: Optional[dict] = None
+    # streaming tasks: executor stays at most this many unconsumed items
+    # ahead of the consumer (ref: _generator_backpressure_num_objects);
+    # None = unbounded
+    generator_backpressure: Optional[int] = None
 
     def return_ids(self) -> List[ObjectID]:
+        if self.num_returns == STREAMING:
+            return []   # item ids are created incrementally as they stream
         return [ObjectID.for_return(self.task_id, i + 1) for i in range(self.num_returns)]
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.num_returns == STREAMING
 
     def scheduling_class(self) -> Tuple:
         """Tasks with equal class can reuse a lease (ref: SchedulingClass).

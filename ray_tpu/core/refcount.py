@@ -94,6 +94,19 @@ class ReferenceCounter:
         if freed:
             self._on_zero(oid)
 
+    def release_owned_if_unreferenced(self, oid: ObjectID) -> bool:
+        """Free an owned object NOW if nothing references it. Needed for
+        objects registered owned without any local ObjectRef (stream items
+        the consumer never claimed): no decrement event will ever fire for
+        them, so an explicit sweep is the only path to _on_zero."""
+        freed = False
+        with self._lock:
+            if oid in self._owned:
+                freed = self._zero_locked(oid)
+        if freed:
+            self._on_zero(oid)
+        return freed
+
     def on_task_submitted(self, arg_ids) -> None:
         with self._lock:
             for oid in arg_ids:

@@ -16,7 +16,7 @@ from ray_tpu.core import runtime as rt
 _TASK_OPTIONS = {
     "num_cpus", "num_tpus", "memory", "resources", "num_returns",
     "max_retries", "retry_exceptions", "scheduling_strategy", "name",
-    "runtime_env",
+    "runtime_env", "generator_backpressure",
 }
 
 
@@ -35,21 +35,29 @@ class RemoteFunction:
         return RemoteFunction(self._fn, merged)
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.core.common import STREAMING
+
         o = self._options
         runtime = rt.get_runtime()
         resources = ResourceSet.from_options(
             o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
             o.get("resources"))
+        nr = o.get("num_returns", 1)
+        if nr in ("streaming", "dynamic"):
+            nr = STREAMING   # generator task (ref: num_returns="dynamic")
         refs = runtime.submit_task(
             self._fn, args, kwargs,
             name=o.get("name") or getattr(self._fn, "__name__", "task"),
-            num_returns=o.get("num_returns", 1),
+            num_returns=nr,
             resources=resources,
             max_retries=o.get("max_retries"),
             retry_exceptions=o.get("retry_exceptions", False),
             scheduling=o.get("scheduling_strategy") or SchedulingStrategy(),
-            runtime_env=o.get("runtime_env"))
-        if o.get("num_returns", 1) == 1:
+            runtime_env=o.get("runtime_env"),
+            generator_backpressure=o.get("generator_backpressure"))
+        if nr == STREAMING:
+            return refs   # an ObjectRefGenerator
+        if nr == 1:
             return refs[0]
         return refs
 

@@ -23,9 +23,11 @@ import asyncio
 import hashlib
 import random
 import logging
+import contextvars
 import threading
 import time
 import traceback
+import weakref
 from collections import defaultdict, deque
 from concurrent.futures import Future as SyncFuture
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
@@ -33,8 +35,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 import cloudpickle
 
 from ray_tpu.core import serialization
-from ray_tpu.core.common import (Address, ObjectRef, ResourceSet, RuntimeAddress,
-                                 SchedulingStrategy, TaskResult, TaskSpec)
+from ray_tpu.core.common import (STREAMING, Address, ObjectRef,
+                                 ObjectRefGenerator, ResourceSet,
+                                 RuntimeAddress, SchedulingStrategy,
+                                 TaskResult, TaskSpec)
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import MemoryStore, SharedMemoryStore, _MISSING
@@ -108,6 +112,96 @@ class _PendingTask:
         self.retries_left = retries_left
 
 
+class _ExecCtxVar:
+    """Execution-context slot with threading.local's attribute interface
+    but contextvars storage: per-THREAD for sync executors (as before)
+    AND per-asyncio-TASK on the loop, so concurrent streaming actor
+    coroutines (Serve max_concurrency) can't clobber each other's
+    task_id/put_index across awaits."""
+
+    __slots__ = ("_var",)
+
+    def __init__(self):
+        object.__setattr__(self, "_var", contextvars.ContextVar(
+            "ray_tpu_exec_ctx"))
+
+    def _dict(self) -> dict:
+        d = self._var.get(None)
+        if d is None:
+            d = {}
+            self._var.set(d)
+        return d
+
+    def _replace(self, d: dict):
+        """Install a FRESH dict for this task/thread. Tasks copy their
+        context at creation, so mutating an inherited dict would leak
+        across sibling tasks — entering an execution context must
+        replace, not update."""
+        self._var.set(d)
+
+    def __getattr__(self, name):
+        try:
+            return self._dict()[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __setattr__(self, name, value):
+        self._dict()[name] = value
+
+
+class _ReadPin:
+    """Holds one store read pin for exactly as long as any zero-copy value
+    derived from the object's bytes is alive. Deserialized arrays export
+    their buffers from THIS object (PEP 688 __buffer__), so the buffer
+    chain keeps the pin alive and the release fires when the LAST value
+    dies — not when the ObjectRef does. Without this, `get(ref)` followed
+    by dropping the ref frees the store region while the returned numpy
+    view still aliases it, and the next allocation silently rewrites the
+    value's bytes (ref: plasma buffers hold a client reference until the
+    Python buffer object is destroyed)."""
+
+    __slots__ = ("_store", "_oid", "_view", "__weakref__")
+
+    def __init__(self, store, oid, view):
+        self._store = store
+        self._oid = oid
+        self._view = view
+
+    def __buffer__(self, flags):
+        return memoryview(self._view)
+
+    def __del__(self):
+        self._view = None
+        try:
+            self._store.release(self._oid)
+        except Exception:
+            pass   # interpreter/store teardown
+
+
+class _StreamState:
+    """Owner-side record of one streaming-generator task (ref:
+    task_manager.h:143-171 ObjectRefStream): item entries live in the
+    ordinary object directory under ObjectID.for_return(task_id, index);
+    this tracks end-of-stream and wakes blocked consumers."""
+
+    __slots__ = ("produced", "total", "error", "kick", "consumed",
+                 "abandoned", "consumed_waiters")
+
+    def __init__(self):
+        self.produced = 0     # highest item index reported ready
+        self.total = None     # item count once the generator finished
+        self.error = None     # SerializedException raised after last item
+        self.kick = threading.Event()   # pulsed on every stream update
+        self.consumed = 0     # highest index handed to the consumer
+        # no consumer exists (lineage re-execution of a GC'd stream):
+        # items are still accepted but nothing backpressures
+        self.abandoned = False
+        # (threshold, asyncio.Future) pairs: backpressured item acks
+        # waiting for consumption to reach their threshold; guarded by
+        # Runtime._stream_lock (mutated from loop AND consumer threads)
+        self.consumed_waiters: List[Tuple[int, Any]] = []
+
+
 class Runtime:
     """One per process. mode: "driver" | "worker"."""
 
@@ -138,17 +232,20 @@ class Runtime:
                                      self._notify_owner)
         self.directory: Dict[ObjectID, _ObjectEntry] = {}
         self._dir_lock = threading.Lock()
-        # Read pins backing zero-copy values handed to the user; held until
-        # the owning ref is GC'd. Spill safety against these pins lives in
-        # the native store (ts_evict frees only when refcount is the
-        # nodelet's own pin).
-        self._pinned: Dict[ObjectID, memoryview] = {}
+        # Read pins backing zero-copy values handed to the user; weakrefs
+        # to _ReadPin guards, which release when the last derived value
+        # dies. Spill safety against these pins lives in the native store
+        # (ts_evict frees only when refcount is the nodelet's own pin).
+        self._pinned: Dict[ObjectID, Any] = {}
 
         # submission state, per scheduling class
         self._queues: Dict[Tuple, deque] = defaultdict(deque)
         self._class_leases: Dict[Tuple, List[_LeasedWorker]] = defaultdict(list)
         self._class_pending_lease: Dict[Tuple, int] = defaultdict(int)
         self._inflight: Dict[TaskID, _PendingTask] = {}
+        # streaming-generator tasks owned here (ref: task_manager.h:143-171)
+        self._streams: Dict[TaskID, _StreamState] = {}
+        self._stream_lock = threading.Lock()
 
         # actor client state
         self._actor_addr: Dict[ActorID, Optional[Address]] = {}
@@ -161,7 +258,7 @@ class Runtime:
         # execution context (worker mode): thread-local so concurrent actor
         # threads get distinct put-id spaces (ref: TaskID-scoped put indices)
         self.current_task_id: TaskID = TaskID.for_driver(job_id)
-        self._exec_ctx = threading.local()
+        self._exec_ctx = _ExecCtxVar()
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._fn_cache: Dict[bytes, Any] = {}
@@ -278,16 +375,13 @@ class Runtime:
 
     def set_exec_context(self, task_id: TaskID,
                          runtime_env: Optional[dict] = None):
-        self._exec_ctx.task_id = task_id
-        self._exec_ctx.put_index = 0
         # Nested submissions from inside this task inherit its env
         # (ref: runtime_env inheritance parent → child).
-        self._exec_ctx.runtime_env = runtime_env
+        self._exec_ctx._replace({"task_id": task_id, "put_index": 0,
+                                 "runtime_env": runtime_env})
 
     def clear_exec_context(self):
-        self._exec_ctx.task_id = None
-        self._exec_ctx.put_index = 0
-        self._exec_ctx.runtime_env = None
+        self._exec_ctx._replace({})
 
     def get_current_task_id(self) -> TaskID:
         tid = getattr(self._exec_ctx, "task_id", None)
@@ -381,12 +475,9 @@ class Runtime:
         """All refs gone: drop every copy (ref: ReferenceCounter on-zero →
         delete from plasma + local memory store; lineage released)."""
         self.memory_store.delete(oid)
-        v = self._pinned.pop(oid, None)
-        if v is not None:
-            try:
-                del v
-            finally:
-                self.store.release(oid)
+        # NOT store.release here: live zero-copy values hold their own
+        # pin via _ReadPin and release when the last one dies
+        self._pinned.pop(oid, None)
         with self._dir_lock:
             e = self.directory.pop(oid, None)
         if e is not None and e.locations:
@@ -477,14 +568,21 @@ class Runtime:
         return self._get_borrowed(ref, deadline, _depth)
 
     def _read_local(self, oid: ObjectID):
-        view = self.store.get_view(oid)
-        if view is None:
-            return _MISSING
-        if oid not in self._pinned:
-            self._pinned[oid] = view          # hold pin for zero-copy validity
-        else:
-            self.store.release(oid)           # already pinned once
-        value = serialization.read_from(self._pinned[oid])
+        wr = self._pinned.get(oid)
+        pin = wr() if wr is not None else None
+        if pin is None:
+            view = self.store.get_view(oid)   # +1 store refcount
+            if view is None:
+                self._pinned.pop(oid, None)
+                return _MISSING
+            pin = _ReadPin(self.store, oid, view)
+            self._pinned[oid] = weakref.ref(
+                pin, lambda r, oid=oid: (
+                    self._pinned.pop(oid, None)
+                    if self._pinned.get(oid) is r else None))
+        # values deserialize out of memoryview(pin): their buffer chains
+        # keep the pin (and thus the store region) alive
+        value = serialization.read_from(memoryview(pin))
         if isinstance(value, serialization.SerializedException):
             raise value.to_exception()
         return value
@@ -654,10 +752,37 @@ class Runtime:
         flight (check-then-submit must be one critical section or the two
         paths double-execute and double-decrement arg refcounts)."""
         with self._recon_lock:
-            entries = [self._entry(rid) for rid in spec.return_ids()]
-            if any(en.state == "pending" for en in entries):
+            rids = spec.return_ids()
+            if spec.is_streaming:
+                # re-execution re-yields every item; only LOST entries are
+                # reset (live copies elsewhere must not be clobbered —
+                # rpc_stream_item skips complete entries)
+                if spec.task_id in self._inflight:
+                    return False    # a re-execution is already running
+                st = self._streams.get(spec.task_id)
+                if st is None:
+                    # generator handle was GC'd and its state dropped:
+                    # revive an abandoned state so re-reported items are
+                    # accepted (and nothing backpressures — no consumer)
+                    st = self._streams[spec.task_id] = _StreamState()
+                    st.abandoned = True
+                hi = max(st.produced, st.total or 0)
+                if hi == 0:
+                    # state was revived: recover the watermark from the
+                    # directory (item entries outlive the stream state)
+                    with self._dir_lock:
+                        while ObjectID.for_return(
+                                spec.task_id, hi + 1) in self.directory:
+                            hi += 1
+                    st.produced = hi
+                rids = [ObjectID.for_return(spec.task_id, i + 1)
+                        for i in range(hi)]
+                rids = [r for r in rids if self._entry(r).state == "lost"]
+            entries = [self._entry(rid) for rid in rids]
+            if not spec.is_streaming \
+                    and any(en.state == "pending" for en in entries):
                 return False
-            for rid, re_ in zip(spec.return_ids(), entries):
+            for rid, re_ in zip(rids, entries):
                 re_.state = "pending"
                 re_.inline = None
                 re_.locations = set()
@@ -892,7 +1017,9 @@ class Runtime:
                     max_retries: Optional[int] = None,
                     retry_exceptions: bool = False,
                     scheduling: Optional[SchedulingStrategy] = None,
-                    runtime_env: Optional[dict] = None) -> List[ObjectRef]:
+                    runtime_env: Optional[dict] = None,
+                    generator_backpressure: Optional[int] = None
+                    ) -> List[ObjectRef]:
         """ref: CoreWorker::SubmitTask core_worker.cc:1855."""
         fid = self.export_function(fn)
         task_id = TaskID(os_urandom4() + b"\x00" * 8 + self.job_id.binary())
@@ -906,9 +1033,12 @@ class Runtime:
             retry_exceptions=retry_exceptions,
             scheduling=scheduling or SchedulingStrategy(),
             runtime_env=self.resolve_runtime_env(runtime_env),
-            trace_ctx=self._trace_ctx())
+            trace_ctx=self._trace_ctx(),
+            generator_backpressure=generator_backpressure)
         refs = self._register_returns(spec, arg_ids)
         self._submit_spec(spec, retries_left=mr)
+        if spec.is_streaming:
+            return ObjectRefGenerator(spec.task_id, self.address)
         return refs
 
     @staticmethod
@@ -924,6 +1054,8 @@ class Runtime:
 
     def _register_returns(self, spec: TaskSpec, arg_ids: List[ObjectID]) -> List[ObjectRef]:
         refs = []
+        if spec.is_streaming:
+            self._streams.setdefault(spec.task_id, _StreamState())
         for rid in spec.return_ids():
             e = self._entry(rid)
             e.spec = spec                      # lineage
@@ -1157,7 +1289,7 @@ class Runtime:
 
     def _complete_task(self, spec: TaskSpec, result: TaskResult, cls: Optional[Tuple]):
         app_error = None
-        for (kind, payload), rid in zip(result.returns, spec.return_ids()):
+        for kind, payload in result.returns:
             if kind == "err":
                 app_error = payload
                 break
@@ -1169,6 +1301,8 @@ class Runtime:
                 self._queues[cls].append(spec)
                 self._spawn(self._pump_class(cls))
                 return
+        if spec.is_streaming:
+            self._finalize_stream_on_result(spec, error=app_error)
         for (kind, payload), rid in zip(result.returns, spec.return_ids()):
             e = self._entry(rid)
             if kind == "inline":
@@ -1202,6 +1336,8 @@ class Runtime:
         ser = serialization.SerializedException(exc, "".join(
             traceback.format_exception(type(exc), exc, exc.__traceback__)),
             wrap=False)
+        if spec.is_streaming:
+            self._finalize_stream_on_result(spec, error=ser)
         for rid in spec.return_ids():
             e = self._entry(rid)
             e.error = ser
@@ -1349,6 +1485,8 @@ class Runtime:
         refs = self._register_returns(spec, arg_ids)
         self._actor_queue(actor_id).append((spec, max_task_retries))
         self._spawn(self._actor_sender(actor_id))
+        if spec.is_streaming:
+            return ObjectRefGenerator(spec.task_id, self.address)
         return refs
 
     def _actor_queue(self, actor_id: ActorID) -> deque:
@@ -1453,6 +1591,156 @@ class Runtime:
         with self._dir_lock:
             locs = [list(a) for a in e.locations]
         return {"status": "ready", "inline": None, "locations": locs}
+
+    # ------------------------------------------- streaming generators (owner)
+
+    def stream_progress(self, task_id: TaskID) -> Tuple[int, Optional[int]]:
+        st = self._streams.get(task_id)
+        if st is None:
+            return (0, None)
+        return (st.produced, st.total)
+
+    def next_stream_ref(self, task_id: TaskID, index: int,
+                        timeout: Optional[float] = None) -> Optional[ObjectRef]:
+        """Block until item `index` of the stream is ready; None on clean
+        end-of-stream; raises the task's error once all yielded items were
+        consumed (ref: generator semantics in task_manager.h:143-171)."""
+        st = self._streams.get(task_id)
+        if st is None:
+            raise ValueError(f"no stream for task {task_id.hex()[:12]}")
+        rid = ObjectID.for_return(task_id, index)
+        e = self._entry(rid)
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            if e.event.is_set() and e.state in ("ready", "error"):
+                self._advance_consumed(st, index)
+                return ObjectRef(rid, self.address)
+            if st.total is not None and index > st.total:
+                if st.error is not None:
+                    raise st.error.to_exception()
+                return None
+            if deadline is not None and time.time() >= deadline:
+                raise GetTimeoutError(
+                    f"stream item {index} of {task_id.hex()[:12]} not ready "
+                    "in time")
+            st.kick.clear()
+            # re-check after clear: an update between the checks above and
+            # the clear would otherwise be a lost wakeup (conditions must
+            # mirror the loop's exits exactly or this spins)
+            if (e.event.is_set() and e.state in ("ready", "error")) \
+                    or (st.total is not None and index > st.total):
+                continue
+            st.kick.wait(1.0)
+
+    def _advance_consumed(self, st: _StreamState, index: int):
+        """Consumer progress: release backpressured item acks whose
+        threshold has been reached. Called from consumer threads; waiter
+        futures complete on the loop. The check-then-append in
+        rpc_stream_item and the advance-then-filter here must each be
+        atomic or a waiter registered between them is never fired."""
+        with self._stream_lock:
+            if index <= st.consumed:
+                return
+            st.consumed = index
+            fire = [f for thr, f in st.consumed_waiters if thr <= index]
+            st.consumed_waiters = [(thr, f) for thr, f in st.consumed_waiters
+                                   if thr > index]
+        for f in fire:
+            try:
+                self.loop.call_soon_threadsafe(
+                    lambda f=f: f.done() or f.set_result(None))
+            except RuntimeError:
+                pass
+
+    def drop_stream(self, task_id: TaskID):
+        """Consumer discarded the generator: release any blocked executor
+        (its next item report returns ok=False, stopping production),
+        drop the state, and free produced-but-never-claimed items — no
+        ObjectRef exists for those, so no decrement event would ever free
+        them. Claimed items' entries persist under their refs' lifecycle;
+        lineage reconstruction revives a fresh state via
+        _reset_and_resubmit."""
+        st = self._streams.pop(task_id, None)
+        if st is None:
+            return
+        lo, hi = st.consumed, st.produced
+        self._advance_consumed(st, st.produced + 10**9)
+        st.kick.set()
+        for i in range(lo + 1, hi + 1):
+            self.refs.release_owned_if_unreferenced(
+                ObjectID.for_return(task_id, i))
+
+    async def rpc_stream_item(self, task_id: TaskID, index: int, kind: str,
+                              payload: Any,
+                              backpressure: Optional[int] = None) -> dict:
+        """Executor reports one yielded item (ref: ReportGeneratorItemReturns).
+        Idempotent: a retried generator re-reports earlier indices onto
+        already-complete entries, which are left untouched. With
+        backpressure=N the ack is withheld until the consumer is within N
+        items of this one — the executor's blocking report call IS the
+        flow control (ref: _generator_backpressure_num_objects)."""
+        st = self._streams.get(task_id)
+        if st is None:
+            return {"ok": False, "reason": "unknown-stream"}
+        rid = ObjectID.for_return(task_id, index)
+        e = self._entry(rid)
+        if not e.event.is_set():
+            self.refs.register_owned(rid)
+            pt = self._inflight.get(task_id)
+            e.spec = pt.spec if pt is not None else e.spec   # lineage
+            if kind == "inline":
+                e.inline = payload
+                try:
+                    self.memory_store.put(rid, serialization.unpack(payload))
+                except Exception:
+                    pass
+            else:
+                e.locations.add(tuple(payload["addr"]))
+                e.primaries.add(tuple(payload["addr"]))
+                e.size = payload.get("size", 0)
+            e.state = "ready"
+            self._complete_entry(e)
+        st.produced = max(st.produced, index)
+        st.kick.set()
+        fut = None
+        if backpressure is not None and not st.abandoned:
+            with self._stream_lock:
+                # membership re-check: a concurrent drop_stream fires
+                # existing waiters and pops the state — appending to an
+                # orphaned state would wait forever
+                if self._streams.get(task_id) is not st:
+                    return {"ok": False, "reason": "dropped"}
+                if index - st.consumed > backpressure:
+                    fut = self.loop.create_future()
+                    st.consumed_waiters.append((index - backpressure, fut))
+        if fut is not None:
+            await fut
+            if self._streams.get(task_id) is not st:
+                return {"ok": False, "reason": "dropped"}
+        return {"ok": True}
+
+    async def rpc_stream_done(self, task_id: TaskID, total: int,
+                              error: Any = None) -> dict:
+        st = self._streams.get(task_id)
+        if st is None:
+            return {"ok": False, "reason": "unknown-stream"}
+        if st.total is None:   # first finalization wins (retries re-report)
+            st.total = total
+            st.error = error
+        st.kick.set()
+        return {"ok": True}
+
+    def _finalize_stream_on_result(self, spec: TaskSpec, error=None):
+        """Owner-side safety net: freeze the stream when the task result
+        arrives, in case the executor died between its last item and the
+        stream_done call."""
+        st = self._streams.get(spec.task_id)
+        if st is None:
+            return
+        if st.total is None:
+            st.total = st.produced
+            st.error = error
+        st.kick.set()
 
     async def rpc_recover_object(self, oid: ObjectID,
                                  dead_locations=None) -> dict:

@@ -24,7 +24,7 @@ from typing import Any, List, Optional, Tuple
 from ray_tpu.core import serialization
 from ray_tpu.core.common import ObjectRef, RuntimeAddress, TaskResult, TaskSpec
 from ray_tpu.core.config import Config
-from ray_tpu.core.ids import JobID, NodeID, TaskID
+from ray_tpu.core.ids import JobID, NodeID, ObjectID, TaskID
 from ray_tpu.core.runtime import Runtime, set_runtime
 from ray_tpu.core.serialization import SerializedException
 
@@ -87,26 +87,71 @@ class Worker:
                 f"{type(values).__name__}")
         returns = []
         for i, v in enumerate(values):
-            rid = spec.return_ids()[i]
-            meta, bufs = serialization.serialize(v)
-            size = serialization.serialized_size(meta, bufs)
-            if size <= self.runtime.cfg.max_direct_call_object_size:
-                packed = bytearray(size)
-                serialization.write_to(memoryview(packed), meta, bufs)
-                returns.append(("inline", bytes(packed)))
-            else:
-                store = self.runtime.store
-                view = self.runtime._create_view_with_spill(rid, size)
-                if view is not None:
-                    serialization.write_to(view, meta, bufs)
-                    del view
-                    store.seal(rid)
-                    self.runtime._pin_primary(rid)  # nodelet owns the pin
-                elif not store.contains(rid):
-                    raise MemoryError(f"object store full storing return {i}")
-                returns.append(("store", {"addr": self.runtime.nodelet_addr,
-                                          "size": size}))
+            returns.append(self._package_one(spec.return_ids()[i], v))
         return TaskResult(spec.task_id, returns)
+
+    def _package_one(self, rid, v) -> Tuple[str, Any]:
+        """Serialize one return/stream item: inline when small, into the
+        node store (nodelet-pinned) when large."""
+        meta, bufs = serialization.serialize(v)
+        size = serialization.serialized_size(meta, bufs)
+        if size <= self.runtime.cfg.max_direct_call_object_size:
+            packed = bytearray(size)
+            serialization.write_to(memoryview(packed), meta, bufs)
+            return ("inline", bytes(packed))
+        store = self.runtime.store
+        view = self.runtime._create_view_with_spill(rid, size)
+        if view is not None:
+            serialization.write_to(view, meta, bufs)
+            del view
+            store.seal(rid)
+            self.runtime._pin_primary(rid)  # nodelet owns the pin
+        elif not store.contains(rid):
+            raise MemoryError(
+                f"object store full storing {rid.hex()[:12]}")
+        return ("store", {"addr": self.runtime.nodelet_addr, "size": size})
+
+    def _stream_item_coro(self, spec: TaskSpec, idx: int, kind, payload):
+        """The one report-item RPC both streaming drivers share. With
+        backpressure the owner deliberately withholds the ack until the
+        consumer catches up — that call gets a generous deadline."""
+        owner = self.runtime.pool.get(spec.owner.addr)
+        bp = spec.generator_backpressure
+        return owner.call(
+            "stream_item", task_id=spec.task_id, index=idx, kind=kind,
+            payload=payload, backpressure=bp,
+            timeout=3600.0 if bp is not None else 30.0)
+
+    def _stream_done_coro(self, spec: TaskSpec, total: int):
+        return self.runtime.pool.get(spec.owner.addr).call(
+            "stream_done", task_id=spec.task_id, total=total, timeout=30.0)
+
+    def _stream_returns(self, spec: TaskSpec, gen) -> TaskResult:
+        """Drive a generator task: report each yielded item to the owner
+        as it is produced (ref: task_manager.h:143-171 streaming returns /
+        ReportGeneratorItemReturns). Runs on an executor thread; RPCs
+        bridge onto the runtime loop."""
+        if not hasattr(gen, "__iter__") or isinstance(gen, (str, bytes,
+                                                            list, tuple,
+                                                            dict)):
+            raise TypeError(
+                f"task {spec.name} declared num_returns='streaming' but "
+                f"returned {type(gen).__name__}, not a generator/iterator")
+        idx = 0
+        for item in gen:
+            idx += 1
+            kind, payload = self._package_one(
+                ObjectID.for_return(spec.task_id, idx), item)
+            r = self.runtime._run(self._stream_item_coro(spec, idx, kind,
+                                                         payload))
+            if not r.get("ok"):
+                # owner dropped the stream (scope exit / shutdown): stop
+                # producing and let generator cleanup run
+                if hasattr(gen, "close"):
+                    gen.close()
+                break
+        self.runtime._run(self._stream_done_coro(spec, idx))
+        return TaskResult(spec.task_id, [])
 
     def _execute(self, spec: TaskSpec, fn=None) -> TaskResult:
         """Runs on an executor thread — NEVER on the asyncio loop: it blocks
@@ -130,6 +175,10 @@ class Worker:
                     fn = self.runtime.load_function(spec.func_id)
                 args, kwargs = self._resolve_args(spec)
                 value = fn(*args, **kwargs)
+                if spec.is_streaming:
+                    # stream inside the env/trace context: the generator
+                    # body runs lazily, during iteration
+                    return self._stream_returns(spec, value)
             return self._package_returns(spec, value)
         except BaseException as e:
             tb = traceback.format_exc()
@@ -192,6 +241,36 @@ class Worker:
             def method(*a, **k):
                 raise AttributeError(
                     f"actor has no method {spec.method_name!r}")
+        if inspect.isasyncgenfunction(method) and spec.is_streaming:
+            # async-generator streaming method (the Serve token-streaming
+            # path): items are produced and reported on the loop;
+            # serialization hops to an executor thread because packaging
+            # large items blocks on the nodelet pin RPC.
+            async with self._async_sem:
+                loop = asyncio.get_running_loop()
+                try:
+                    args, kwargs = await loop.run_in_executor(
+                        self.task_executor, self._resolve_args, spec)
+                    self.runtime.set_exec_context(spec.task_id)
+                    agen = method(*args, **kwargs)
+                    idx = 0
+                    async for item in agen:
+                        idx += 1
+                        kind, payload = await loop.run_in_executor(
+                            None, self._package_one,
+                            ObjectID.for_return(spec.task_id, idx), item)
+                        r = await self._stream_item_coro(spec, idx, kind,
+                                                         payload)
+                        if not r.get("ok"):
+                            await agen.aclose()
+                            break
+                    await self._stream_done_coro(spec, idx)
+                    return TaskResult(spec.task_id, [])
+                except BaseException as e:
+                    ser = SerializedException(e, traceback.format_exc())
+                    return TaskResult(spec.task_id, [("err", ser)])
+                finally:
+                    self.runtime.clear_exec_context()
         if inspect.iscoroutinefunction(method):
             # async actor: method coroutine runs on the loop (ref: fibers,
             # fiber.h); arg resolution still happens off-loop because it may

@@ -22,7 +22,8 @@ import numpy as np
 Block = Union[Dict[str, np.ndarray], list]
 
 _DEFAULT_BLOCK_ROWS = 4096
-_WINDOW = 4  # max in-flight transform tasks per iterator (backpressure)
+_WINDOW = 4  # streaming shard tasks per iterator (execution parallelism)
+_STREAM_AHEAD = 2  # blocks each shard executor may run ahead of consumption
 
 
 def _block_rows(b: Block) -> int:
@@ -243,8 +244,13 @@ class Dataset:
         return Dataset(refs, [])
 
     def _iter_blocks(self) -> Iterator[Block]:
-        """Streaming pull: at most _WINDOW transform tasks in flight
-        (the backpressure loop of streaming_executor_state.py)."""
+        """Streaming pull: _WINDOW generator tasks each transform a
+        strided shard of the blocks, yielding results block-at-a-time;
+        consumer-coupled generator backpressure keeps every executor at
+        most _STREAM_AHEAD blocks ahead of consumption, so memory is
+        bounded regardless of dataset size (ref: streaming generators
+        feeding streaming_executor_state.py's backpressure loop).
+        Round-robin over strided shards restores original block order."""
         import ray_tpu
 
         ops = self._ops
@@ -252,20 +258,30 @@ class Dataset:
             for ref in self._block_refs:
                 yield ray_tpu.get(ref)
             return
+        refs = self._block_refs
+        if not refs:
+            return
+        w = min(_WINDOW, len(refs))
 
-        @ray_tpu.remote
-        def _t(block):
-            return _transform_block(block, ops)
+        @ray_tpu.remote(num_returns="streaming",
+                        generator_backpressure=_STREAM_AHEAD)
+        def _shard_t(shard_refs, ops):
+            for r in shard_refs:
+                yield _transform_block(ray_tpu.get(r), ops)
 
-        pending: List[Any] = []
-        it = iter(self._block_refs)
-        for ref in itertools.islice(it, _WINDOW):
-            pending.append(_t.remote(ref))
-        for ref in it:
-            yield ray_tpu.get(pending.pop(0))
-            pending.append(_t.remote(ref))
-        for p in pending:
-            yield ray_tpu.get(p)
+        active = [_shard_t.remote(refs[i::w], ops)
+                  for i in builtins.range(w)]
+        while active:
+            exhausted = []
+            for g in active:
+                try:
+                    ref = next(g)
+                except StopIteration:
+                    exhausted.append(g)
+                    continue
+                yield ray_tpu.get(ref)
+            for g in exhausted:
+                active.remove(g)
 
     # ---- consumption -------------------------------------------------------
 

@@ -61,6 +61,48 @@ class Replica:
         finally:
             self.inflight -= 1
 
+    @ray_tpu.method(num_returns="streaming")
+    async def handle_request_streaming(self, method: str, args, kwargs,
+                                       context: dict | None = None):
+        """Streaming twin of handle_request (ref: the proxy's
+        obj-ref-generator calls for response streaming): drives the user
+        method — async generator, sync generator, or iterable-returning —
+        and yields each item as a stream element."""
+        self.inflight += 1
+        try:
+            if context and "multiplexed_model_id" in context:
+                from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+                _set_multiplexed_model_id(context["multiplexed_model_id"])
+            import asyncio
+            import inspect
+
+            fn = getattr(self.instance, method)
+            if inspect.isasyncgenfunction(fn):
+                async for item in fn(*args, **kwargs):
+                    yield item
+                return
+            if inspect.iscoroutinefunction(fn):
+                out = await fn(*args, **kwargs)
+            else:
+                out = await asyncio.to_thread(fn, *args, **kwargs)
+            if inspect.isgenerator(out) or (
+                    hasattr(out, "__iter__")
+                    and not isinstance(out, (str, bytes, dict, list,
+                                             tuple))):
+                loop = asyncio.get_running_loop()
+                _end = object()
+                it = iter(out)
+                while True:   # sync generator: step off-loop per item
+                    item = await loop.run_in_executor(None, next, it, _end)
+                    if item is _end:
+                        return
+                    yield item
+            else:
+                yield out
+        finally:
+            self.inflight -= 1
+
     def queue_len(self) -> int:
         return self.inflight
 

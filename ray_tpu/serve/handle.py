@@ -67,18 +67,22 @@ class DeploymentHandle:
         self.deployment_name = deployment_name
         self._router: Optional[Router] = None
         self._context: dict = {}
+        self._stream = False
 
     def _get_router(self) -> Router:
         if self._router is None:
             self._router = Router(self.deployment_name)
         return self._router
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
-        """Per-call options (ref: handle.options(multiplexed_model_id=...))."""
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        """Per-call options (ref: handle.options(multiplexed_model_id=...,
+        stream=True)). stream=True makes .remote() return an
+        ObjectRefGenerator of the handler's yielded items."""
         h = DeploymentHandle(self.deployment_name)
         h._router = self._get_router()     # share router state
         h._context = dict(self._context)
+        h._stream = self._stream if stream is None else stream
         if multiplexed_model_id is not None:
             h._context["multiplexed_model_id"] = multiplexed_model_id
         return h
@@ -97,10 +101,12 @@ class DeploymentHandle:
 
     def _call(self, method: str, args, kwargs):
         router = self._get_router()
+        entry = ("handle_request_streaming" if getattr(self, "_stream", False)
+                 else "handle_request")
         for attempt in range(3):
             idx, replica = router.pick()
             try:
-                ref = getattr(replica, "handle_request").remote(
+                ref = getattr(replica, entry).remote(
                     method, args, kwargs, self._context or None)
                 router.done(idx)
                 return ref

@@ -10,6 +10,7 @@ Python results to HTTP responses.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -109,7 +110,11 @@ class HTTPProxy:
 
             def _dispatch(self):
                 try:
-                    status, ctype, body, extra = proxy._handle(self)
+                    out = proxy._handle(self)
+                    if out[0] == "stream":
+                        self._stream_out(out[1], out[2])
+                        return
+                    status, ctype, body, extra = out
                 except Exception as e:  # noqa: BLE001 — proxy must not die
                     import traceback
 
@@ -125,6 +130,42 @@ class HTTPProxy:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _stream_out(self, ctype, chunks):
+                """Chunked transfer encoding over the handler socket: each
+                stream item flushes as its own chunk, so clients see
+                tokens as they are generated. The first chunk is pulled
+                BEFORE the headers commit, so an immediately-failing
+                stream still gets a clean 500; later failures must not
+                write a status line into the chunk framing — they emit an
+                error chunk and terminate the stream instead."""
+                it = iter(chunks)
+                try:
+                    first = next(it, None)
+                except Exception:   # noqa: BLE001 — headers not sent yet
+                    raise           # -> _dispatch's 500 path
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    try:
+                        for data in itertools.chain(
+                                [] if first is None else [first], it):
+                            if not data:
+                                continue
+                            self.wfile.write(
+                                f"{len(data):x}\r\n".encode() + data
+                                + b"\r\n")
+                            self.wfile.flush()
+                    except Exception as e:  # noqa: BLE001 mid-stream error
+                        err = json.dumps({"error": str(e)}).encode() + b"\n"
+                        self.wfile.write(
+                            f"{len(err):x}\r\n".encode() + err + b"\r\n")
+                        self.close_connection = True   # stream cut short
+                    self.wfile.write(b"0\r\n\r\n")
+                except BrokenPipeError:
+                    pass   # client went away; generator cleanup in chunks()
 
             do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _dispatch
 
@@ -192,9 +233,30 @@ class HTTPProxy:
 
             handle = DeploymentHandle(deployment)
             self._handles[deployment] = handle
+        if req.query_params.get("stream") == "1" or \
+                "text/event-stream" in h.headers.get("Accept", ""):
+            # Streaming contract: the deployment defines `stream_request`
+            # (sync/async generator); items flush to the client as HTTP
+            # chunks in yield order (ref: serve response streaming over
+            # obj-ref generators).
+            gen = handle.options(stream=True).method(
+                "stream_request").remote(req)
+            return ("stream", "text/plain; charset=utf-8",
+                    self._iter_chunks(gen))
         ref = handle.remote(req)
         result = ray_tpu.get(ref, timeout=60)
         return _encode_result(result)
+
+    @staticmethod
+    def _iter_chunks(gen):
+        for ref in gen:
+            item = ray_tpu.get(ref)
+            if isinstance(item, (bytes, bytearray)):
+                yield bytes(item)
+            elif isinstance(item, str):
+                yield item.encode()
+            else:
+                yield (json.dumps(item) + "\n").encode()
 
     def ready(self) -> int:
         return self.port

@@ -36,6 +36,8 @@ class _Request:
     slot: int = -1
     generated: List[int] = field(default_factory=list)
     done_event: threading.Event = field(default_factory=threading.Event)
+    # pulsed whenever generated grows (token-streaming consumers wait on it)
+    progress: threading.Event = field(default_factory=threading.Event)
     submit_time: float = field(default_factory=time.time)
     first_token_time: Optional[float] = None
 
@@ -206,6 +208,7 @@ class LLMEngine:
                     r.slot = -1
                     self._masks_dirty = True
             r.done_event.set()
+            r.progress.set()
 
     def step(self) -> int:
         """Admit + one decode step for all active slots. Returns number of
@@ -235,6 +238,7 @@ class LLMEngine:
             r.generated.append(tok)
             self.metrics["tokens_generated"] += 1
             self._maybe_finish(r)
+            r.progress.set()
         with self.lock:
             return sum(1 for s in self.slots if s is not None)
 
@@ -286,6 +290,7 @@ class LLMEngine:
                 r.generated.append(int(toks[j, r.slot]))
                 self.metrics["tokens_generated"] += 1
                 self._maybe_finish(r)
+            r.progress.set()
         with self.lock:
             return sum(1 for s in self.slots if s is not None)
 
@@ -335,6 +340,41 @@ class LLMServer:
         ttft = (req.first_token_time - req.submit_time
                 if req.first_token_time else None)
         return {"tokens": req.generated, "ttft_s": ttft}
+
+    async def stream_request(self, request) -> Any:
+        """Token-streaming endpoint (the proxy's streaming contract; ref:
+        serve response streaming): yields each newly generated token batch
+        as soon as the decode loop lands it, finishing with a stats line.
+        `request` is an http_proxy.Request (?stream=1) or a plain dict
+        (handle calls)."""
+        body = request if isinstance(request, dict) else request.json()
+        req = self.engine.submit(list(body["prompt"]),
+                                 int(body.get("max_new_tokens", 32)),
+                                 float(body.get("temperature", 0.0)))
+        self._wake.set()
+        loop = asyncio.get_running_loop()
+        cursor = 0
+        while True:
+            new = req.generated[cursor:]
+            if new:
+                cursor += len(new)
+                yield {"tokens": new}
+            elif req.done_event.is_set():
+                # done was observed AFTER an empty snapshot; tokens may
+                # have landed between the two — drain once more
+                new = req.generated[cursor:]
+                if new:
+                    cursor += len(new)
+                    yield {"tokens": new}
+                break
+            else:
+                req.progress.clear()
+                if len(req.generated) > cursor or req.done_event.is_set():
+                    continue   # progress raced the clear
+                await loop.run_in_executor(None, req.progress.wait, 1.0)
+        ttft = (req.first_token_time - req.submit_time
+                if req.first_token_time else None)
+        yield {"done": True, "n_tokens": cursor, "ttft_s": ttft}
 
     def stats(self) -> Dict[str, Any]:
         m = dict(self.engine.metrics)

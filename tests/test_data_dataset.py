@@ -590,6 +590,33 @@ def test_unique_and_show(ray_start_regular, capsys):
     assert out.count("\n") == 2
 
 
+def test_iter_blocks_streaming_backpressure(ray_start_regular, tmp_path):
+    """Producers must not run unboundedly ahead of a slow consumer: each
+    shard executor stalls in its withheld item ack once it is
+    _STREAM_AHEAD blocks ahead (streaming-generator backpressure)."""
+    import time
+
+    from ray_tpu import data
+
+    marker_dir = tmp_path
+
+    def mark(batch):
+        (marker_dir / f"b{int(batch['id'][0])}").write_text("x")
+        return batch
+
+    ds = data.range(20, num_blocks=20).map_batches(mark)
+    it = iter(ds._iter_blocks())
+    for _ in range(4):                   # consume one round-robin round
+        next(it)
+    time.sleep(1.5)                      # give producers time to run ahead
+    produced = len(list(marker_dir.iterdir()))
+    # 4 shards x (1 consumed + 2 ahead + 1 awaiting ack) = 16 max
+    assert produced < 20, "producers transformed everything despite slow consumer"
+    rest = list(it)
+    assert len(rest) == 16               # and the stream still completes
+    assert len(list(marker_dir.iterdir())) == 20
+
+
 def test_unique_after_emptying_filter(ray_start_regular):
     """unique() must skip blocks fully emptied by an upstream filter —
     they pass through as schemaless [] (regression for ADVICE r1)."""
