@@ -68,10 +68,14 @@ def init(address: Optional[str] = None, *,
          _system_config: Optional[Dict[str, Any]] = None) -> dict:
     """Start (or connect to) a ray_tpu cluster.
 
-    address=None starts a new local cluster (gcs + one nodelet);
-    address="host:port" connects to an existing GCS.
+    address=None starts a new local cluster (gcs + one nodelet) unless
+    RAY_TPU_ADDRESS is set (the launcher's exec/attach/submit export it —
+    ref: ray.init() honoring RAY_ADDRESS); address="host:port" connects
+    to an existing GCS.
     ref: worker.py:1108 init / node.py:1148 start_head_processes.
     """
+    if address is None:
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
     global _session
     with _init_lock:
         if is_initialized():

@@ -305,8 +305,48 @@ def main():
     s.add_argument("--config", default=None, help="config file for deploy")
     s.set_defaults(fn=cmd_serve)
 
+    # cluster launcher (ref: scripts.py:1238,1314,1398,1696 up/down/
+    # attach/exec over the NodeProvider API)
+    s = sub.add_parser("up", help="bring a cluster up from a YAML config")
+    s.add_argument("cluster_yaml")
+    s.add_argument("--restart", action="store_true")
+    s.set_defaults(fn=lambda a: _launcher().up(a.cluster_yaml,
+                                               restart=a.restart))
+
+    s = sub.add_parser("down", help="tear a cluster down")
+    s.add_argument("cluster_yaml")
+    s.set_defaults(fn=lambda a: _launcher().down(a.cluster_yaml))
+
+    s = sub.add_parser("exec", help="run a shell command on the cluster")
+    s.add_argument("cluster_yaml")
+    s.add_argument("command")
+    s.set_defaults(fn=lambda a: sys.exit(
+        _launcher().exec_cmd(a.cluster_yaml, a.command)))
+
+    s = sub.add_parser("submit", help="run a python script on the cluster")
+    s.add_argument("cluster_yaml")
+    s.add_argument("script")
+    s.add_argument("script_args", nargs="*")
+    s.set_defaults(fn=lambda a: sys.exit(
+        _launcher().submit(a.cluster_yaml, a.script, *a.script_args)))
+
+    s = sub.add_parser("attach",
+                       help="shell with the cluster address exported")
+    s.add_argument("cluster_yaml")
+    s.set_defaults(fn=lambda a: sys.exit(_launcher().attach(a.cluster_yaml)))
+
+    s = sub.add_parser("cluster-status", help="launcher-level status")
+    s.add_argument("cluster_yaml")
+    s.set_defaults(fn=lambda a: _launcher().status(a.cluster_yaml))
+
     args = p.parse_args()
     args.fn(args)
+
+
+def _launcher():
+    from ray_tpu.autoscaler import launcher
+
+    return launcher
 
 
 if __name__ == "__main__":

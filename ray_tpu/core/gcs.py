@@ -440,7 +440,10 @@ class GcsServer:
             if rec is not None and rec.state == DEAD:
                 return {"ok": False, "view": rec.view()}
             await asyncio.sleep(0.05)
-        return {"ok": False, "view": None}
+        # timed out: return the current view so callers can tell a
+        # still-starting actor (keep waiting) from an unknown id (fail)
+        rec = self.actors.get(actor_id)
+        return {"ok": False, "view": rec.view() if rec is not None else None}
 
     async def _publish_actor(self, rec: ActorRecord):
         await self._publish(f"actor:{rec.actor_id.hex()}", rec.view())

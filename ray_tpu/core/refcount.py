@@ -29,13 +29,17 @@ from ray_tpu.core.ids import ObjectID
 class ReferenceCounter:
     def __init__(self, self_addr_fn: Callable[[], Optional[RuntimeAddress]],
                  on_zero: Callable[[ObjectID], None],
-                 notify_owner: Callable[[RuntimeAddress, str, ObjectID], None]):
+                 notify_owner: Callable[[RuntimeAddress, str, ObjectID], None],
+                 on_borrow_zero: Optional[Callable[[ObjectID], None]] = None):
         """notify_owner(owner, op, oid) sends borrow add/remove to a remote
-        owner asynchronously; on_zero(oid) frees an owned object."""
+        owner asynchronously; on_zero(oid) frees an owned object;
+        on_borrow_zero(oid) drops local caches of a borrowed object whose
+        last local ref died (the owner keeps the authoritative copy)."""
         self._lock = threading.Lock()
         self._self_addr_fn = self_addr_fn
         self._on_zero = on_zero
         self._notify_owner = notify_owner
+        self._on_borrow_zero = on_borrow_zero or (lambda oid: None)
         # owned objects: oid -> counts
         self._local: Dict[ObjectID, int] = defaultdict(int)
         self._submitted: Dict[ObjectID, int] = defaultdict(int)
@@ -89,8 +93,13 @@ class ReferenceCounter:
                         notify = True
                     else:
                         self._borrowed[oid] = (owner_addr, n - 1)
-        if notify and me is not None:
-            self._notify_owner(owner, "remove_borrow", oid)
+        if notify:
+            # last local borrow died: drop local caches (memory-store
+            # entries warmed by prefetch, read pins) — no other decrement
+            # event exists for borrowed ids, so skipping this leaks them
+            self._on_borrow_zero(oid)
+            if me is not None:
+                self._notify_owner(owner, "remove_borrow", oid)
         if freed:
             self._on_zero(oid)
 

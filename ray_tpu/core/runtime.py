@@ -229,7 +229,8 @@ class Runtime:
         self.memory_store = MemoryStore()
         self.store = SharedMemoryStore(store_name)
         self.refs = ReferenceCounter(self._self_addr, self._free_object,
-                                     self._notify_owner)
+                                     self._notify_owner,
+                                     on_borrow_zero=self._free_borrow_caches)
         self.directory: Dict[ObjectID, _ObjectEntry] = {}
         self._dir_lock = threading.Lock()
         # Read pins backing zero-copy values handed to the user; weakrefs
@@ -240,6 +241,9 @@ class Runtime:
 
         # submission state, per scheduling class
         self._queues: Dict[Tuple, deque] = defaultdict(deque)
+        # concurrent lease-requesting pumps per class (ref: the reference's
+        # max_pending_lease_requests_per_scheduling_category)
+        self._max_pumps = max(8, int(cfg.max_workers_per_node))
         self._class_leases: Dict[Tuple, List[_LeasedWorker]] = defaultdict(list)
         self._class_pending_lease: Dict[Tuple, int] = defaultdict(int)
         self._inflight: Dict[TaskID, _PendingTask] = {}
@@ -263,6 +267,8 @@ class Runtime:
         self._put_lock = threading.Lock()
         self._fn_cache: Dict[bytes, Any] = {}
         self._exported: Set[bytes] = set()
+        # weak identity cache fn-object -> fid (dead functions drop out)
+        self._fid_by_obj: Any = weakref.WeakKeyDictionary()
         self.default_runtime_env: Optional[dict] = None  # job-level env
         self._renv_cache: Dict[str, dict] = {}
         self._task_events: List[dict] = []
@@ -471,6 +477,12 @@ class Runtime:
                 return None  # nothing left to spill; store genuinely full
         return None
 
+    def _free_borrow_caches(self, oid: ObjectID):
+        """Last local borrow of a remote-owned object died: drop OUR
+        caches only (the owner's copy is none of our business)."""
+        self.memory_store.delete(oid)
+        self._pinned.pop(oid, None)
+
     def _free_object(self, oid: ObjectID):
         """All refs gone: drop every copy (ref: ReferenceCounter on-zero →
         delete from plasma + local memory store; lineage released)."""
@@ -507,11 +519,60 @@ class Runtime:
         depth = getattr(self._exec_ctx, "get_depth", 0)
         self._exec_ctx.get_depth = depth + 1
         try:
+            if len(refs) > 1:
+                self._prefetch_borrowed(refs, deadline)
             return [self._get_one(r, deadline) for r in refs]
         finally:
             self._exec_ctx.get_depth = depth
             if depth == 0:
                 self._end_block()
+
+    def _prefetch_borrowed(self, refs: Sequence[ObjectRef],
+                           deadline: Optional[float]):
+        """Batch resolution of borrowed refs: ONE wait_objects RPC per
+        distinct owner instead of a serial wait_object round-trip per ref
+        (a task taking N object args would otherwise pay N round-trips —
+        ref: the plasma provider's batched GetObjects). Inline results are
+        cached into the memory store; everything else falls back to the
+        ordinary per-ref path, which this pass only warms."""
+        groups: Dict[Address, List[ObjectID]] = {}
+        for r in refs:
+            oid = r.id
+            if self.refs.is_owned(oid) or self.memory_store.contains(oid) \
+                    or self.store.contains(oid):
+                continue
+            groups.setdefault(tuple(r.owner.addr), []).append(oid)
+        if not groups:
+            return
+        rem = self._remaining(deadline)
+        step = min(rem, 30.0) if rem is not None else 30.0
+        self._ensure_blocked()
+
+        async def _bulk():
+            async def one(addr, oids):
+                try:
+                    return await self.pool.get(addr).call(
+                        "wait_objects", oids=oids, wait_timeout=step,
+                        timeout=step + 10.0)
+                except Exception:
+                    return None
+            return await asyncio.gather(
+                *(one(a, oids) for a, oids in groups.items()))
+
+        try:
+            replies = self._run(_bulk(), timeout=step + 15.0)
+        except Exception:
+            return   # warming only; the per-ref path is authoritative
+        for (addr, oids), reply in zip(groups.items(), replies):
+            if not reply:
+                continue
+            for oid, r in zip(oids, reply["results"]):
+                if r.get("status") == "ready" and r.get("inline") is not None:
+                    try:
+                        self.memory_store.put(
+                            oid, serialization.unpack(r["inline"]))
+                    except Exception:
+                        pass
 
     def _ensure_blocked(self):
         """Called LAZILY from the wait paths, just before the first
@@ -965,13 +1026,26 @@ class Runtime:
     # ------------------------------------------------------ function shipping
 
     def export_function(self, fn: Any) -> bytes:
-        """ref: function_manager.py:61 — pickled code via GCS KV, lazy import."""
+        """ref: function_manager.py:61 — pickled code via GCS KV, lazy
+        import. The identity fast path skips re-pickling on every .remote()
+        of the same function object (pickling dominated submission cost);
+        a re-DEFINED function is a different object and re-exports."""
+        try:
+            fid = self._fid_by_obj.get(fn)
+        except TypeError:
+            fid = None   # unhashable / non-weakrefable callable
+        if fid is not None:
+            return fid
         blob = _dumps_function(fn)
         fid = hashlib.sha1(blob).digest()
         if fid not in self._exported:
             self.kv_put("fn", fid, blob, overwrite=False)
             self._exported.add(fid)
             self._fn_cache[fid] = fn
+        try:
+            self._fid_by_obj[fn] = fid
+        except TypeError:
+            pass   # unhashable callable: no fast path
         return fid
 
     def load_function(self, fid: bytes) -> Any:
@@ -1119,8 +1193,17 @@ class Runtime:
         target = (self._locality_target(spec)
                   if spec.scheduling.kind == "DEFAULT" else None)
         cls = (spec.scheduling_class(), target)
-        self._queues[cls].append(spec)
-        self._spawn(self._pump_class(cls))
+        q = self._queues[cls]
+        q.append(spec)
+        # Bounded pumps (ref: direct_task_transport.cc lease rate limiting):
+        # a pump per submission would fire one lease request per queued
+        # task — 100k queued tasks must not mean 100k in-flight lease RPCs.
+        # Active pumps drain the whole queue via pipelining; exiting pumps
+        # respawn while work remains, so capping spawns loses no liveness.
+        active = (len(self._class_leases[cls])
+                  + self._class_pending_lease[cls])
+        if active < self._max_pumps and active < len(q):
+            self._spawn(self._pump_class(cls))
 
     async def _enqueue_when_ready(self, spec: TaskSpec,
                                   pending: List[ObjectID]):
@@ -1170,6 +1253,11 @@ class Runtime:
         finally:
             self._class_leases[cls].remove(lw)
             await self._return_lease(lw)
+            # a task enqueued while this pump was between its last queue
+            # check and the lease return may have been gated out — liveness
+            # requires the exiting pump to respawn when work remains
+            if self._queues[cls] and not self._shutdown:
+                self._spawn(self._pump_class(cls))
 
     def _locality_target(self, spec: TaskSpec) -> Optional[Address]:
         """Lease-target choice by data locality (ref: lease_policy.h
@@ -1448,7 +1536,14 @@ class Runtime:
     def subscribe_logs(self):
         self._subscribe_channel("log")
 
-    def _resolve_actor(self, actor_id: ActorID, timeout: float = 60.0) -> Address:
+    async def _resolve_actor(self, actor_id: ActorID,
+                             timeout: Optional[float] = None) -> Address:
+        """Wait for the actor to be ALIVE. No arbitrary deadline: like the
+        reference's actor submit queue, calls buffer while the actor is
+        still starting/restarting (a 200-actor fleet on a slow node takes
+        minutes to spawn) and fail only when the GCS declares it DEAD —
+        or the optional caller deadline passes. Runs as a coroutine on the
+        runtime loop so a fleet of pending actors parks zero threads."""
         addr = self._actor_addr.get(actor_id)
         if addr is not None:
             return addr
@@ -1456,16 +1551,27 @@ class Runtime:
         if st is not None and st.get("state") == "DEAD":
             raise ActorDiedError(f"actor {actor_id.hex()[:12]} is dead: "
                                  f"{st.get('death_cause')}")
-        r = self.gcs_call("wait_actor_alive", actor_id=actor_id, wait_timeout=timeout,
-                          rpc_timeout=timeout + 10.0)
-        view = r.get("view")
-        if view is not None:
-            self._actor_state[actor_id] = view
-        if not r.get("ok"):
-            cause = (view or {}).get("death_cause", "not alive in time")
-            raise ActorDiedError(f"actor {actor_id.hex()[:12]}: {cause}")
-        self._actor_addr[actor_id] = tuple(view["address"])
-        return self._actor_addr[actor_id]
+        deadline = None if timeout is None else time.time() + timeout
+        view = None
+        while not self._shutdown:
+            step = 30.0
+            if deadline is not None:
+                step = min(step, max(0.1, deadline - time.time()))
+            r = await self.pool.get(self.gcs_addr).call(
+                "wait_actor_alive", actor_id=actor_id, wait_timeout=step,
+                timeout=step + 10.0)
+            view = r.get("view")
+            if view is not None:
+                self._actor_state[actor_id] = view
+            if r.get("ok"):
+                self._actor_addr[actor_id] = tuple(view["address"])
+                return self._actor_addr[actor_id]
+            if view is None or view.get("state") == "DEAD":
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+        cause = (view or {}).get("death_cause", "not alive in time")
+        raise ActorDiedError(f"actor {actor_id.hex()[:12]}: {cause}")
 
     def submit_actor_call(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict, *, num_returns: int = 1,
@@ -1509,10 +1615,16 @@ class Runtime:
             while q:
                 spec, retries = q.popleft()
                 try:
-                    addr = await asyncio.get_running_loop().run_in_executor(
-                        None, self._resolve_actor, actor_id)
+                    addr = await self._resolve_actor(actor_id)
                 except (ActorDiedError, ActorUnavailableError) as e:
                     self._fail_task_returns(spec, e)
+                    continue
+                except (ConnectionLost, RemoteError, OSError):
+                    # GCS blip (restart/failover): requeue and retry —
+                    # gcs reconnect logic lives in gcs_call, which this
+                    # loop-native wait path bypasses
+                    q.appendleft((spec, retries))
+                    await asyncio.sleep(1.0)
                     continue
                 client = self.pool.get(tuple(addr))
                 try:
@@ -1741,6 +1853,14 @@ class Runtime:
             st.total = st.produced
             st.error = error
         st.kick.set()
+
+    async def rpc_wait_objects(self, oids: List[ObjectID],
+                               wait_timeout: float = 30.0) -> dict:
+        """Bulk wait_object: one round-trip resolves many borrowed refs
+        (ref: batched GetObjects on the store providers)."""
+        results = await asyncio.gather(
+            *(self.rpc_wait_object(oid, wait_timeout) for oid in oids))
+        return {"results": list(results)}
 
     async def rpc_recover_object(self, oid: ObjectID,
                                  dead_locations=None) -> dict:

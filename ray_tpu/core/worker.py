@@ -67,12 +67,17 @@ class Worker:
                     else:
                         oid, owner = pv
                         kwargs[k] = ObjectRef(oid, owner)
-        # Dependency resolution: refs are fetched before user code runs
-        # (ref: _raylet.pyx deserializes args via plasma before execution).
-        args = [self.runtime.get([a])[0] if isinstance(a, ObjectRef) else a
-                for a in args]
-        kwargs = {k: (self.runtime.get([v])[0] if isinstance(v, ObjectRef) else v)
-                  for k, v in kwargs.items()}
+        # Dependency resolution: refs are fetched before user code runs,
+        # in ONE batched get so borrowed args share round-trips (ref:
+        # _raylet.pyx deserializes args via plasma before execution).
+        refs = [a for a in args if isinstance(a, ObjectRef)]
+        refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        if refs:
+            vals = iter(self.runtime.get(refs))
+            args = [next(vals) if isinstance(a, ObjectRef) else a
+                    for a in args]
+            kwargs = {k: (next(vals) if isinstance(v, ObjectRef) else v)
+                      for k, v in kwargs.items()}
         return args, kwargs
 
     def _package_returns(self, spec: TaskSpec, values: Any) -> TaskResult:

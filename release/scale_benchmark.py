@@ -1,0 +1,255 @@
+"""Scale-envelope benchmark: probes the dimensions the reference publishes
+in release/benchmarks/README.md:5-32 (queued tasks per node, actors,
+wait/get batch width, object args/returns per task, multi-node broadcast),
+box-scaled: the reference uses 64-core nodes, this harness typically runs
+on one shared vCPU — treat outputs as same-harness baselines.
+
+Each stage prints one JSON line: {"bench": ..., "value": ..., "unit": ...}.
+
+    python release/scale_benchmark.py                 # CI-scale defaults
+    python release/scale_benchmark.py --full          # envelope scale
+    python release/scale_benchmark.py --only queued_tasks --tasks 100000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _emit(bench: str, value, unit: str, **extra):
+    line = {"bench": bench, "value": round(value, 2), "unit": unit}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def bench_queued_tasks(n: int):
+    """N tasks queued against one node's lease pipeline (ref envelope:
+    1M queued on a 64-core m4.16xlarge). Measures submit rate (how fast
+    the owner can queue) and drain throughput (lease-pipelined
+    execution)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    t0 = time.time()
+    refs = [noop.remote(i) for i in range(n)]
+    submit_dt = time.time() - t0
+    _emit("queued_tasks_submit", n / submit_dt, "tasks/s", n=n,
+          rss_mb=round(_rss_mb()))
+    t0 = time.time()
+    out = ray_tpu.get(refs, timeout=3600)
+    drain_dt = time.time() - t0
+    assert out[-1] == n - 1
+    _emit("queued_tasks_drain", n / drain_dt, "tasks/s", n=n,
+          total_s=round(submit_dt + drain_dt, 1), rss_mb=round(_rss_mb()))
+
+
+def bench_wait_scale(n: int):
+    """ray.wait over N refs (ref: ray_perf.py:169 wait on 1k refs)."""
+    import ray_tpu
+
+    refs = [ray_tpu.put(i) for i in range(n)]
+    t0 = time.time()
+    for _ in range(10):
+        ready, _pending = ray_tpu.wait(refs, num_returns=n, timeout=60)
+        assert len(ready) == n
+    dt = (time.time() - t0) / 10
+    _emit("wait_n_refs", n / dt, "refs/s", n=n, ms_per_wait=round(dt * 1e3, 1))
+
+
+def bench_get_batch(n: int):
+    """One ray.get over N store objects (ref envelope: 10k+ plasma
+    objects in one get)."""
+    import ray_tpu
+
+    payload = np.zeros(1024, np.uint8)           # store-path sized
+    refs = [ray_tpu.put(payload) for _ in range(n)]
+    t0 = time.time()
+    out = ray_tpu.get(refs, timeout=600)
+    dt = time.time() - t0
+    assert len(out) == n
+    _emit("get_batch", n / dt, "objects/s", n=n)
+
+
+def bench_many_args(n: int):
+    """One task taking N object refs as args (ref envelope: 10k+ args)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def count(*parts):
+        return len(parts)
+
+    refs = [ray_tpu.put(i) for i in range(n)]
+    t0 = time.time()
+    assert ray_tpu.get(count.remote(*refs), timeout=600) == n
+    _emit("args_per_task", n / (time.time() - t0), "args/s", n=n)
+
+
+def bench_many_returns(n: int):
+    """One task returning N objects (ref envelope: 3k+ returns)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns=n)
+    def fan(k):
+        return tuple(range(k))
+
+    t0 = time.time()
+    refs = fan.remote(n)
+    out = ray_tpu.get(refs, timeout=600)
+    dt = time.time() - t0
+    assert out[-1] == n - 1
+    _emit("returns_per_task", n / dt, "returns/s", n=n)
+
+
+def bench_streaming_returns(n: int):
+    """One generator task streaming N item refs (dynamic returns have no
+    per-task cap — the envelope dimension the fixed-returns limit used
+    to bound)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(k):
+        yield from range(k)
+
+    t0 = time.time()
+    seen = 0
+    for ref in gen.remote(n):
+        seen += 1
+    dt = time.time() - t0
+    assert seen == n
+    _emit("streamed_items_per_task", n / dt, "items/s", n=n)
+
+
+def bench_actors(n: int):
+    """N concurrent actors on one node (ref envelope: 40k cluster-wide
+    on 4096 cores). Zero-CPU actors so scheduling, not resources, is the
+    limit; one round-trip call each proves liveness."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def pid(self):
+            return os.getpid()
+
+    t0 = time.time()
+    actors = [A.remote() for _ in range(n)]
+    pids = ray_tpu.get([a.pid.remote() for a in actors], timeout=3600)
+    dt = time.time() - t0
+    _emit("actors_created_and_called", n / dt, "actors/s", n=n,
+          distinct_workers=len(set(pids)), total_s=round(dt, 1))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def bench_broadcast(nodes: int, mib: int):
+    """One owner puts a large object; one task per extra node pulls it
+    (ref envelope: 1 GiB broadcast to 50+ nodes; the emergent
+    distribution tree lets pulled copies serve later pulls)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    cluster = Cluster(initialize_head=True, head_resources={"CPU": 4})
+    try:
+        for _ in range(nodes):
+            cluster.add_node(resources={"CPU": 2})
+        cluster.connect()
+        nodes_info = [n for n in ray_tpu.nodes() if n["Alive"]]
+        arr = np.random.default_rng(0).integers(
+            0, 255, size=mib * 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+
+        @ray_tpu.remote(num_cpus=0.5)
+        def touch(a):
+            return int(a[0]) + len(a)
+
+        t0 = time.time()
+        refs = []
+        for ni in nodes_info:
+            refs.append(touch.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=ni["NodeID"])).remote(ref))
+        out = ray_tpu.get(refs, timeout=600)
+        dt = time.time() - t0
+        assert all(o == out[0] for o in out)
+        _emit("broadcast", mib * len(nodes_info) / dt, "MiB/s",
+              mib=mib, nodes=len(nodes_info), total_s=round(dt, 1))
+    finally:
+        cluster.shutdown()
+
+
+STAGES = ["queued_tasks", "wait_scale", "get_batch", "many_args",
+          "many_returns", "streaming_returns", "actors", "broadcast"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="envelope scale (minutes) instead of CI scale")
+    ap.add_argument("--only", choices=STAGES, default=None)
+    ap.add_argument("--tasks", type=int, default=None)
+    ap.add_argument("--actors", type=int, default=None)
+    args = ap.parse_args()
+
+    scale = {
+        "tasks": args.tasks or (100_000 if args.full else 2_000),
+        "wait": 10_000 if args.full else 2_000,
+        "get": 5_000 if args.full else 1_000,
+        "args": 2_000 if args.full else 500,
+        "returns": 1_000 if args.full else 200,
+        "stream": 5_000 if args.full else 500,
+        "actors": args.actors or (200 if args.full else 50),
+        "bcast_nodes": 4 if args.full else 2,
+        "bcast_mib": 256 if args.full else 64,
+    }
+
+    import ray_tpu
+
+    stages = [args.only] if args.only else STAGES
+    single_node = [s for s in stages if s != "broadcast"]
+    if single_node:
+        ray_tpu.init(num_cpus=8, _system_config={
+            # actors hold dedicated workers; the pool must cover the fleet
+            "max_workers_per_node": max(64, scale["actors"] + 16),
+            "worker_start_timeout_s": 300.0,
+            # a 200-process fork storm on one vCPU starves heartbeats;
+            # widen the failure window so slowness isn't "death"
+            "health_check_timeout_s": 30.0,
+            "health_check_failure_threshold": 20})
+        try:
+            if "queued_tasks" in stages:
+                bench_queued_tasks(scale["tasks"])
+            if "wait_scale" in stages:
+                bench_wait_scale(scale["wait"])
+            if "get_batch" in stages:
+                bench_get_batch(scale["get"])
+            if "many_args" in stages:
+                bench_many_args(scale["args"])
+            if "many_returns" in stages:
+                bench_many_returns(scale["returns"])
+            if "streaming_returns" in stages:
+                bench_streaming_returns(scale["stream"])
+            if "actors" in stages:
+                bench_actors(scale["actors"])
+        finally:
+            ray_tpu.shutdown()
+    if "broadcast" in stages:
+        bench_broadcast(scale["bcast_nodes"], scale["bcast_mib"])
+
+
+if __name__ == "__main__":
+    main()
