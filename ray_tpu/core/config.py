@@ -29,8 +29,12 @@ class Config:
     # use the portable chunk-RPC pull path.
     native_transfer_enabled: bool = True
     # kCreating store entries older than this are orphans of a dead
-    # producer and get reaped (local writes take seconds; remote pulls
-    # are bounded by the 120s transfer socket timeout).
+    # producer and get reaped. The transfer plane heartbeats the entry
+    # per read() batch while bytes flow, and each read() is bounded by
+    # the 120 s socket timeout — so a live pull's touch interval never
+    # exceeds ~120 s and a stalled one aborts. MUST stay comfortably
+    # above that 120 s bound or the reaper can free a buffer an active
+    # (trickling) receive is still writing into.
     creating_orphan_age_s: float = 300.0
     # --- object spilling (ref: local_object_manager.h:41 + external_storage) -
     object_spill_enabled: bool = True

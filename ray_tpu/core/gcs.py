@@ -16,8 +16,11 @@ subsystems this module holds in one asyncio process:
 - job table, task-event sink (ref: gcs_task_manager.h), pub/sub push
   (ref: src/ray/pubsub/)
 
-Storage is a pluggable snapshot: "memory" (default) or "file" (pickle
-snapshot for GCS restart; ref: GcsTableStorage memory/Redis backends).
+Storage is pluggable (ref: GcsTableStorage memory/Redis backends,
+gcs_table_storage.h:252): "memory" (default, no durability) or "file" —
+debounced pickle snapshots PLUS a per-mutation append-WAL
+(core/gcs_storage.py), so every acked write survives a GCS crash, not
+just state as of the last snapshot point.
 """
 
 from __future__ import annotations
@@ -95,6 +98,13 @@ class GcsServer:
         self._round_robin = 0
         self._stopping = False
         self._dirty = False
+        # pluggable persistence: snapshot + append-WAL (ref:
+        # gcs_table_storage.h:252 over memory/redis store clients)
+        from ray_tpu.core.gcs_storage import FileGcsStorage, MemoryGcsStorage
+        if cfg.gcs_storage == "file" and cfg.gcs_file_storage_path:
+            self.storage = FileGcsStorage(cfg.gcs_file_storage_path)
+        else:
+            self.storage = MemoryGcsStorage()
         # node_id -> {actor_id_hex: {"addr", "worker_id"}} from re-registration
         self._hosted: Dict[NodeID, dict] = {}
 
@@ -165,6 +175,7 @@ class GcsServer:
                     b["node_id"] = None
                     changed = True
             if changed:
+                self._wal("pgs", pgid, pg, strict=False)  # node-death path
                 self._mark_dirty()
                 await self._try_place_pg(pgid)
 
@@ -297,14 +308,13 @@ class GcsServer:
                 if existing.state != DEAD:
                     return {"ok": False, "error": f"actor name {key} taken"}
             self.named_actors[key] = spec.actor_id
+            self._wal("named_actors", key, spec.actor_id)
         rec = ActorRecord(spec)
         self.actors[spec.actor_id] = rec
         # Write-through: registration must survive an immediate GCS crash
-        # (ref: Redis-backed GcsTableStorage persists before the reply).
-        # The whole-state snapshot also captures the function-export KV
-        # writes that preceded this registration. Serialization happens on
-        # the loop (consistent view); the file write runs off-loop.
-        await self._snapshot_async()
+        # (ref: Redis-backed GcsTableStorage persists before the reply) —
+        # one WAL record, not a whole-state snapshot per registration.
+        self._wal("actors", spec.actor_id, rec)
         asyncio.get_running_loop().create_task(self._create_actor(rec))
         return {"ok": True}
 
@@ -447,6 +457,8 @@ class GcsServer:
 
     async def _publish_actor(self, rec: ActorRecord):
         await self._publish(f"actor:{rec.actor_id.hex()}", rec.view())
+        # every FSM transition; no RPC caller to fail -> non-strict
+        self._wal("actors", rec.actor_id, rec, strict=False)
         self._mark_dirty()
 
     # -------------------------------------------------------- placement groups
@@ -466,6 +478,7 @@ class GcsServer:
             "state": "PENDING",
         }
         ok = await self._try_place_pg(pg_id)
+        self._wal("pgs", pg_id, self.pgs.get(pg_id))
         self._mark_dirty()
         return {"ok": ok, "state": self.pgs[pg_id]["state"]}
 
@@ -475,6 +488,7 @@ class GcsServer:
         unplaced = [b for b in pg["bundles"] if b["node_id"] is None]
         if not unplaced:
             pg["state"] = "CREATED"
+            self._wal("pgs", pg_id, pg)
             self._mark_dirty()
             return True
         # Phase 0: pick nodes for every unplaced bundle against a scratch view.
@@ -538,6 +552,11 @@ class GcsServer:
                 pass
             b["node_id"] = nid
         pg["state"] = "CREATED"
+        # placement succeeded through PREPARE/COMMIT: the bundle->node
+        # assignments are now reservations held by nodelets and MUST
+        # survive a GCS crash, or restore would double-reserve elsewhere
+        self._wal("pgs", pg_id, pg, strict=False)
+        self._mark_dirty()
         await self._publish(f"pg:{pg_id.hex()}", {"state": "CREATED"})
         return True
 
@@ -545,6 +564,7 @@ class GcsServer:
         pg = self.pgs.pop(pg_id, None)
         if pg is None:
             return {"ok": False}
+        self._wal("pgs", pg_id, None)
         self._mark_dirty()
         for b in pg["bundles"]:
             nid = b.get("node_id")
@@ -586,12 +606,14 @@ class GcsServer:
     async def rpc_add_job(self, job_id: JobID, driver_addr: Address, meta: dict) -> dict:
         self.jobs[job_id] = {"job_id": job_id, "driver": driver_addr,
                              "meta": meta, "start": time.time(), "end": None}
+        self._wal("jobs", job_id, self.jobs[job_id])
         self._mark_dirty()
         return {"ok": True}
 
     async def rpc_finish_job(self, job_id: JobID) -> dict:
         if job_id in self.jobs:
             self.jobs[job_id]["end"] = time.time()
+            self._wal("jobs", job_id, self.jobs[job_id])
             self._mark_dirty()
         return {"ok": True}
 
@@ -606,6 +628,7 @@ class GcsServer:
             # the same first-write succeeds; a genuine conflict still fails.
             return self.kv[k] == value
         self.kv[k] = value
+        self._wal("kv", k, value)
         self._mark_dirty()
         return True
 
@@ -615,6 +638,7 @@ class GcsServer:
     async def rpc_kv_del(self, ns: str, key: bytes) -> bool:
         existed = self.kv.pop((ns, key), None) is not None
         if existed:
+            self._wal("kv", (ns, key), None)
             self._mark_dirty()
         return existed
 
@@ -646,11 +670,13 @@ class GcsServer:
 
     async def rpc_subscribe(self, channel: str, addr: Address) -> dict:
         self.subscribers[channel].add(tuple(addr))
+        self._wal("subscribers", channel, self.subscribers[channel])
         self._mark_dirty()
         return {"ok": True}
 
     async def rpc_unsubscribe(self, channel: str, addr: Address) -> dict:
         self.subscribers[channel].discard(tuple(addr))
+        self._wal("subscribers", channel, self.subscribers[channel])
         self._mark_dirty()
         return {"ok": True}
 
@@ -680,6 +706,25 @@ class GcsServer:
     def _mark_dirty(self):
         self._dirty = True
 
+    def _wal(self, table: str, key, value, strict: bool = True):
+        """Durably log one mutation BEFORE the RPC reply (value=None is a
+        delete). Restore = snapshot + replay; see gcs_storage.py.
+
+        strict=True (mutation RPC handlers): an append failure raises, so
+        the RPC FAILS instead of acking a write that won't survive a crash
+        (ref: the Redis-backed table storage fails the request when the
+        store write fails). strict=False (background FSM transitions with
+        no caller to fail): log and continue — in-memory state stays
+        authoritative until the disk recovers."""
+        try:
+            self.storage.append(pickle.dumps((table, key, value),
+                                             protocol=4))
+        except Exception:
+            logger.exception("gcs wal append failed (table=%s)", table)
+            if strict:
+                raise RuntimeError(
+                    "GCS storage append failed; write not durable") from None
+
     async def _snapshot_loop(self):
         """Debounced persistence: at most one snapshot per period
         (ref: Redis-backed GcsTableStorage writes per-mutation; a periodic
@@ -696,56 +741,75 @@ class GcsServer:
                              "pgs": self.pgs,
                              "subscribers": dict(self.subscribers)})
 
-    def _write_snapshot(self, path: str, data: bytes):
-        with open(path + ".tmp", "wb") as f:
-            f.write(data)
-        os.replace(path + ".tmp", path)
-
-    def _maybe_snapshot(self):
-        path = self._snapshot_path()
-        if not path:
-            return
-        try:
-            self._write_snapshot(path, self._snapshot_bytes())
-        except Exception:
-            logger.exception("gcs snapshot failed")
-
     async def _snapshot_async(self):
-        """Pickle on the loop (consistent state view), write off-loop so
-        heartbeats/leases aren't blocked behind disk I/O."""
+        """Pickle on the loop (consistent state view; the WAL rotates at
+        the same instant, so snapshot+newer-segments is always complete),
+        write off-loop so heartbeats/leases aren't blocked on disk."""
         path = self._snapshot_path()
         if not path:
             return
         try:
             data = self._snapshot_bytes()
-            await asyncio.to_thread(self._write_snapshot, path, data)
+            watermark = self.storage.rotate()
+            await asyncio.to_thread(self.storage.commit_snapshot, data,
+                                    watermark)
         except Exception:
             logger.exception("gcs snapshot failed")
 
     def _maybe_restore(self):
-        path = self._snapshot_path()
-        if not path or not os.path.exists(path):
-            return
         try:
-            with open(path, "rb") as f:
-                data = pickle.load(f)
-            self.kv = data.get("kv", {})
-            self.named_actors = data.get("named_actors", {})
-            self.jobs = data.get("jobs", {})
-            self.actors = data.get("actors", {})
-            self.pgs = data.get("pgs", {})
-            for ch, addrs in data.get("subscribers", {}).items():
-                self.subscribers[ch] |= set(addrs)
-            logger.info("gcs restored %d kv entries, %d actors, %d pgs",
-                        len(self.kv), len(self.actors), len(self.pgs))
+            snap, records = self.storage.restore()
         except Exception:
             logger.exception("gcs restore failed")
+            return
+        if snap is not None:
+            try:
+                data = pickle.loads(snap)
+                self.kv = data.get("kv", {})
+                self.named_actors = data.get("named_actors", {})
+                self.jobs = data.get("jobs", {})
+                self.actors = data.get("actors", {})
+                self.pgs = data.get("pgs", {})
+                for ch, addrs in data.get("subscribers", {}).items():
+                    self.subscribers[ch] |= set(addrs)
+            except Exception:
+                logger.exception("gcs snapshot restore failed")
+        replayed = 0
+        for raw in records:
+            try:
+                table, key, value = pickle.loads(raw)
+            except Exception:
+                continue
+            if table == "subscribers":
+                if value is None:
+                    self.subscribers.pop(key, None)
+                else:
+                    self.subscribers[key] = set(value)
+                replayed += 1
+                continue
+            tab = getattr(self, table, None)
+            if not isinstance(tab, dict):
+                continue
+            if value is None:
+                tab.pop(key, None)
+            else:
+                tab[key] = value
+            replayed += 1
+        if snap is not None or replayed:
+            logger.info(
+                "gcs restored %d kv entries, %d actors, %d pgs "
+                "(+%d WAL records)", len(self.kv), len(self.actors),
+                len(self.pgs), replayed)
 
     async def rpc_ping(self) -> dict:
         return {"ok": True, "time": time.time()}
 
     async def rpc_shutdown(self) -> dict:
         self._stopping = True
+        try:
+            self.storage.close()   # final fsync of the live WAL segment
+        except Exception:
+            pass
         asyncio.get_running_loop().call_later(0.05, _exit_soon)
         return {"ok": True}
 

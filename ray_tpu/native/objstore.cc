@@ -667,6 +667,24 @@ int ts_touch_creating(void* sp, const uint8_t* id) {
   return r;
 }
 
+// CRASH-TEST HOOK: acquire the robust mutex, touch `marker_path` to tell
+// the test harness the lock is held, then sleep. The harness SIGKILLs
+// this process mid-sleep, so the next lock() in any surviving process
+// must take the EOWNERDEAD path (tests/test_native_crash.py). Never used
+// by production code — it exists because killing a process at exactly
+// the right instant is otherwise nondeterministic.
+int ts_debug_lock_hold(void* sp, const char* marker_path, uint32_t millis) {
+  Store* s = reinterpret_cast<Store*>(sp);
+  Header* h = s->hdr;
+  if (lock(h) != 0) return -1;
+  FILE* f = fopen(marker_path, "w");
+  if (f != nullptr) fclose(f);
+  struct timespec ts = {millis / 1000, (long)(millis % 1000) * 1000000L};
+  nanosleep(&ts, nullptr);
+  unlock(h);
+  return 0;
+}
+
 // Entry state probe: 0 = absent, 1 = creating (a racing producer/puller
 // is mid-write), 2 = sealed. Lets the transfer plane distinguish
 // "already here / arriving" from "allocation failed".

@@ -242,25 +242,36 @@ int ts_xfer_fetch(void* store, const char* host, int port,
     return ts_state(store, id) != 0 ? 5 : 3;
   }
   uint8_t* dst = reinterpret_cast<uint8_t*>(ts_seg_base(store)) + off;
-  // chunked receive with a heartbeat per chunk: a slow multi-GB pull
-  // streams continuously but can outlive the orphan-reaper age; the
-  // touch keeps the kCreating entry visibly alive while bytes flow
+  // Receive with a heartbeat per read() batch (at most once a second),
+  // NOT per 64 MiB chunk: a trickling sender can keep one chunk in
+  // flight far past the orphan-reaper age (SO_RCVTIMEO bounds each
+  // read(), not the chunk), and the reaper would free — and possibly
+  // reallocate — the buffer while this loop is still writing into it.
+  // With ≤1 s touch granularity a live socket can never age out; a
+  // fully stalled socket times out in read() and aborts cleanly.
   uint64_t got = 0;
+  uint64_t last_touch = (uint64_t)time(nullptr);
   while (got < total) {
-    uint64_t chunk = total - got > (64ULL << 20) ? (64ULL << 20)
-                                                 : total - got;
-    if (!read_exact(fd, dst + got, chunk)) {
+    uint64_t want = total - got;
+    if (want > (8ULL << 20)) want = (8ULL << 20);
+    ssize_t r = read(fd, dst + got, want);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) {
       ts_abort(store, id);
       close(fd);
       return 4;
     }
-    got += chunk;
-    if (ts_touch_creating(store, id) != 0) {
-      // entry vanished mid-fetch (reaped as an orphan after a long
-      // stall, or deleted): the buffer may already be reallocated —
-      // stop writing and DO NOT seal a foreign entry
-      close(fd);
-      return 4;
+    got += (uint64_t)r;
+    uint64_t now = (uint64_t)time(nullptr);
+    if (now != last_touch) {
+      last_touch = now;
+      if (ts_touch_creating(store, id) != 0) {
+        // entry vanished mid-fetch (reaped after a stall, or deleted):
+        // the buffer may already be reallocated — stop writing
+        // IMMEDIATELY and DO NOT seal a foreign entry
+        close(fd);
+        return 4;
+      }
     }
   }
   close(fd);

@@ -70,6 +70,34 @@ def test_gcs_restart_preserves_state(ft_cluster):
     assert ray_tpu.get(f.remote(41), timeout=60) == 42
 
 
+def test_gcs_wal_survives_kill_between_snapshots(ft_cluster):
+    """Writes acked AFTER the last debounced snapshot must survive a
+    SIGKILL — the append-WAL's whole purpose (round-1 file snapshots lost
+    everything between snapshot points; ref: redis_store_client.h:33
+    persists per mutation)."""
+    cluster = ft_cluster
+    cluster.add_node(resources={"CPU": 4.0})
+    cluster.connect()
+
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    rt.kv_put("wal", b"settled", b"old")
+    time.sleep(1.2)            # let the debounced snapshot cover ^this
+
+    # burst of acked writes, then kill before the 0.5 s debounce can fire
+    for i in range(20):
+        rt.kv_put("wal", f"k{i}".encode(), f"v{i}".encode())
+    rt.gcs_call("kv_del", ns="wal", key=b"settled")
+    cluster.restart_gcs()          # SIGKILL + restart on the same address
+    time.sleep(1.0)
+
+    for i in range(20):
+        assert rt.kv_get("wal", f"k{i}".encode()) == f"v{i}".encode(), \
+            f"acked write k{i} lost between snapshots"
+    assert rt.kv_get("wal", b"settled") is None, "WAL delete not replayed"
+
+
 def test_gcs_restart_mid_actor_creation(ft_cluster):
     """Actors pending creation when the GCS dies are re-driven after
     restart (ref: gcs_actor_manager failover reconstruction)."""
