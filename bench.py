@@ -37,7 +37,11 @@ def detect_peak(device) -> float:
     return PEAK_FLOPS["cpu"] if device.platform == "cpu" else 197e12
 
 
-def main():
+def run_train_bench(preset: str = "debug-125m", batch=None, seq=None,
+                    metric_name=None):
+    """Measure one model preset's train step on the local chip; returns
+    the result dict (shared by bench.py's 125M headline and
+    release/train_benchmark.py's larger presets)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -53,9 +57,13 @@ def main():
 
     # Pallas flash attention (fwd + FlashAttention-2 bwd kernels) on TPU;
     # XLA attention off-TPU where Pallas runs interpreted (slow).
-    cfg = llama.PRESETS["debug-125m"].replace(
+    cfg = llama.PRESETS[preset].replace(
         dtype=dt, remat=True, attn_impl="flash" if on_tpu else "xla")
     B, S = (8, 1024) if on_tpu else (2, 128)
+    if batch is not None:
+        B = batch
+    if seq is not None:
+        S = seq
     mesh = build_mesh(MeshSpec(dp=-1), devices=jax.devices()[:1]) \
         if on_tpu else build_mesh(MeshSpec(dp=-1))
     rules = ShardingRules.dp()
@@ -103,8 +111,9 @@ def main():
     mfu = flops_per_step / dt_s / detect_peak(dev)
     vs_baseline = mfu / 0.30
 
-    print(json.dumps({
-        "metric": "llama125m_train_tokens_per_sec_per_chip",
+    return {
+        "metric": metric_name
+        or f"llama_{preset}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 3),
@@ -113,7 +122,12 @@ def main():
             "step_time_s": round(dt_s, 4), "mfu": round(mfu, 4),
             "params": n_params, "dtype": str(dt.__name__),
         },
-    }))
+    }
+
+
+def main():
+    print(json.dumps(run_train_bench(
+        "debug-125m", metric_name="llama125m_train_tokens_per_sec_per_chip")))
 
 
 if __name__ == "__main__":

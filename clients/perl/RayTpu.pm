@@ -1,0 +1,174 @@
+package RayTpu;
+
+# Perl thin client for the ray_tpu client gateway — the second
+# non-Python language over the same wire the C++ API uses
+# (cpp/src/client.cc), proving the gateway protocol is language-neutral
+# (ref: the reference's multi-language frontends, java/ + cpp/, which
+# reach the core through per-language native bindings; here every
+# language shares ONE length-prefixed JSON protocol, see
+# ray_tpu/client_gateway.py).
+#
+# Uses only core Perl (IO::Socket::INET, JSON::PP, MIME::Base64) so it
+# runs anywhere a stock perl does.
+#
+#   my $c = RayTpu->new(host => "127.0.0.1", port => 10001);
+#   my $ref = $c->put({x => 41});
+#   my $val = $c->get($ref);                       # {x => 41}
+#   my $h   = $c->task("math:hypot", [3, 4]);      # named python fn
+#   my $g   = $c->task("math:floor", [RayTpu->ref_arg($h)]);  # chain refs
+#   my $n   = $c->get($h);                         # 5
+#   my $a   = $c->actor("collections:Counter");
+#   $c->get($c->call($a, "update", [{tpu => 3}]));
+#   $c->kill_actor($a);
+
+use strict;
+use warnings;
+
+use IO::Socket::INET ();
+use JSON::PP         ();
+use MIME::Base64     ();
+
+sub new {
+    my ($class, %opt) = @_;
+    my $host = $opt{host} // "127.0.0.1";
+    my $port = $opt{port} // 10001;
+    my $sock = IO::Socket::INET->new(
+        PeerAddr => $host, PeerPort => $port,
+        Proto    => "tcp", Timeout  => $opt{timeout} // 30,
+    ) or die "ray_tpu gateway connect to $host:$port failed: $!";
+    $sock->sockopt(IO::Socket::INET::SO_KEEPALIVE(), 1);
+    my $self = bless {
+        sock => $sock,
+        json => JSON::PP->new->canonical->allow_nonref,
+        id   => 0,
+    }, $class;
+    $self->_rpc("ping", {});
+    return $self;
+}
+
+# --- framing: [u32 LE length][utf-8 JSON] --------------------------------
+
+sub _read_exact {
+    my ($self, $n) = @_;
+    my $buf = "";
+    while (length($buf) < $n) {
+        my $r = $self->{sock}->sysread(my $chunk, $n - length($buf));
+        die "gateway connection lost" unless defined $r && $r > 0;
+        $buf .= $chunk;
+    }
+    return $buf;
+}
+
+sub _rpc {
+    my ($self, $method, $params) = @_;
+    my $id  = ++$self->{id};
+    my $msg = $self->{json}->encode(
+        { id => $id, method => $method, params => $params });
+    utf8::encode($msg) if utf8::is_utf8($msg);
+    $self->{sock}->syswrite(pack("V", length($msg)) . $msg)
+        or die "gateway write failed: $!";
+    my $len   = unpack("V", $self->_read_exact(4));
+    my $reply = $self->{json}->decode($self->_read_exact($len));
+    die "gateway call $method failed: $reply->{error}" unless $reply->{ok};
+    return $reply->{result};
+}
+
+# --- value codec: bytes and refs use the gateway's extension markers ------
+
+sub bytes_value {    # wrap a raw byte string for transport
+    my ($class, $data) = @_;
+    return { "__bytes__" => MIME::Base64::encode_base64($data, "") };
+}
+
+sub ref_arg {    # wrap a ref id so it travels as an ObjectRef argument
+    my ($class, $ref) = @_;
+    return { "__ref__" => $ref };
+}
+
+# --- API (mirrors cpp/include/raytpu/client.h) ----------------------------
+
+sub put {
+    my ($self, $value) = @_;
+    return $self->_rpc("put", { value => $value })->{ref};
+}
+
+sub get {
+    my ($self, $refs, %opt) = @_;
+    my $many = ref($refs) eq "ARRAY";
+    my $r    = $self->_rpc("get", {
+        refs    => $many ? $refs : [$refs],
+        timeout => $opt{timeout} // 60,
+    });
+    my @vals = @{ $r->{values} };
+    return $many ? \@vals : $vals[0];
+}
+
+sub wait_refs {
+    my ($self, $refs, %opt) = @_;
+    my $r = $self->_rpc("wait", {
+        refs        => $refs,
+        num_returns => $opt{num_returns} // 1,
+        timeout     => $opt{timeout},
+    });
+    return ($r->{ready}, $r->{pending});
+}
+
+sub task {    # named python function "module:attr", args may embed refs
+    my ($self, $func, $args, %opt) = @_;
+    my @wire = @{ $args // [] };
+    my $r = $self->_rpc("task", {
+        func => $func, args => \@wire,
+        ($opt{opts} ? (opts => $opt{opts}) : ()),
+    });
+    my @refs = @{ $r->{refs} };
+    return @refs == 1 ? $refs[0] : \@refs;
+}
+
+sub actor {
+    my ($self, $cls, $args, %opt) = @_;
+    my @wire = @{ $args // [] };
+    return $self->_rpc("actor_create", {
+        cls => $cls, args => \@wire,
+        ($opt{opts} ? (opts => $opt{opts}) : ()),
+    })->{actor};
+}
+
+sub call {
+    my ($self, $actor, $method, $args) = @_;
+    my @wire = @{ $args // [] };
+    my $r = $self->_rpc("actor_call",
+                        { actor => $actor, method => $method,
+                          args  => \@wire });
+    my @refs = @{ $r->{refs} };
+    return @refs == 1 ? $refs[0] : \@refs;
+}
+
+sub get_actor {
+    my ($self, $name, %opt) = @_;
+    return $self->_rpc("get_actor", {
+        name => $name, namespace => $opt{namespace} // "default",
+    })->{actor};
+}
+
+sub kill_actor {
+    my ($self, $actor) = @_;
+    return $self->_rpc("kill", { actor => $actor });
+}
+
+sub release {
+    my ($self, $refs) = @_;
+    return $self->_rpc("release", { refs => $refs });
+}
+
+sub cluster_resources {
+    my ($self) = @_;
+    return $self->_rpc("cluster_resources", {});
+}
+
+sub close {
+    my ($self) = @_;
+    $self->{sock}->close if $self->{sock};
+    $self->{sock} = undef;
+}
+
+1;

@@ -1,0 +1,47 @@
+#!/usr/bin/env perl
+# Perl client walkthrough against a live gateway — the same flow as
+# cpp/examples/basic.cc, over the same wire protocol.
+#   python -m ray_tpu.client_gateway --address <gcs host:port> --port P
+#   perl -Iclients/perl clients/perl/example.pl 127.0.0.1 P
+
+use strict;
+use warnings;
+use FindBin;
+use lib $FindBin::Bin;
+
+use RayTpu;
+
+my ($host, $port) = (@ARGV, "127.0.0.1", 10001);
+my $c = RayTpu->new(host => $host, port => $port);
+
+# objects
+my $ref = $c->put({ x => 41 });
+my $val = $c->get($ref);
+printf("put/get x=%d\n", $val->{x});
+
+# tasks: named python functions run on cluster workers
+my $h = $c->task("math:hypot", [3, 4]);
+printf("math:hypot(3,4) = %g\n", $c->get($h));
+
+# refs chain between tasks without coming back to the client
+my $chained = $c->task("math:floor", [RayTpu->ref_arg($h)]);
+printf("math:floor(ref) = %d\n", $c->get($chained));
+
+# wait over several in-flight tasks
+my @refs = map { $c->task("math:sqrt", [$_]) } (4, 9, 16);
+my ($ready, $pending) = $c->wait_refs(\@refs, num_returns => 3,
+                                      timeout => 60);
+printf("wait: %d ready %d pending\n",
+       scalar(@$ready), scalar(@$pending));
+
+# actors: stateful named python classes
+my $counter = $c->actor("collections:Counter");
+$c->get($c->call($counter, "update", [{ tpu => 3 }]));
+my $top = $c->get($c->call($counter, "most_common"));
+printf("counter: %s=%d\n", $top->[0][0], $top->[0][1]);
+$c->kill_actor($counter);
+
+my $res = $c->cluster_resources();
+printf("cluster CPU: %g\n", $res->{CPU} // 0);
+print("OK\n");
+$c->close;

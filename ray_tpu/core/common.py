@@ -160,12 +160,15 @@ class ObjectRefGenerator:
     def __del__(self):
         # Discarding the generator releases a backpressure-blocked
         # executor (its next report returns ok=False and it stops).
+        # MUST be the deferred variant: a finalizer can run mid-allocation
+        # inside the runtime's own stream-lock critical section, and
+        # taking the lock here would self-deadlock.
         from ray_tpu.core import runtime as rt
 
         r = rt.current_runtime_or_none()
         if r is not None:
             try:
-                r.drop_stream(self.task_id)
+                r.drop_stream_soon(self.task_id)
             except Exception:
                 pass
 
@@ -301,6 +304,10 @@ class TaskSpec:
     # ahead of the consumer (ref: _generator_backpressure_num_objects);
     # None = unbounded
     generator_backpressure: Optional[int] = None
+    # byte-budget variant: ack withheld while unconsumed item BYTES exceed
+    # this (the data layer sizes it from the object-store budget, ref:
+    # streaming_executor_state.py admission by store memory)
+    generator_backpressure_bytes: Optional[int] = None
 
     def return_ids(self) -> List[ObjectID]:
         if self.num_returns == STREAMING:

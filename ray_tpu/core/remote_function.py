@@ -16,7 +16,7 @@ from ray_tpu.core import runtime as rt
 _TASK_OPTIONS = {
     "num_cpus", "num_tpus", "memory", "resources", "num_returns",
     "max_retries", "retry_exceptions", "scheduling_strategy", "name",
-    "runtime_env", "generator_backpressure",
+    "runtime_env", "generator_backpressure", "generator_backpressure_bytes",
 }
 
 
@@ -54,7 +54,9 @@ class RemoteFunction:
             retry_exceptions=o.get("retry_exceptions", False),
             scheduling=o.get("scheduling_strategy") or SchedulingStrategy(),
             runtime_env=o.get("runtime_env"),
-            generator_backpressure=o.get("generator_backpressure"))
+            generator_backpressure=o.get("generator_backpressure"),
+            generator_backpressure_bytes=o.get(
+                "generator_backpressure_bytes"))
         if nr == STREAMING:
             return refs   # an ObjectRefGenerator
         if nr == 1:

@@ -185,7 +185,8 @@ class _StreamState:
     this tracks end-of-stream and wakes blocked consumers."""
 
     __slots__ = ("produced", "total", "error", "kick", "consumed",
-                 "abandoned", "consumed_waiters")
+                 "abandoned", "consumed_waiters", "item_bytes",
+                 "ahead_bytes")
 
     def __init__(self):
         self.produced = 0     # highest item index reported ready
@@ -196,10 +197,14 @@ class _StreamState:
         # no consumer exists (lineage re-execution of a GC'd stream):
         # items are still accepted but nothing backpressures
         self.abandoned = False
-        # (threshold, asyncio.Future) pairs: backpressured item acks
-        # waiting for consumption to reach their threshold; guarded by
-        # Runtime._stream_lock (mutated from loop AND consumer threads)
-        self.consumed_waiters: List[Tuple[int, Any]] = []
+        # (release_cond, asyncio.Future) pairs: backpressured item acks
+        # waiting for consumption; cond() re-evaluated under
+        # Runtime._stream_lock at every consumption advance
+        self.consumed_waiters: List[Tuple[Any, Any]] = []
+        # unconsumed item sizes (byte-budget backpressure, ref: the data
+        # layer's admission by object-store memory)
+        self.item_bytes: Dict[int, int] = {}
+        self.ahead_bytes = 0
 
 
 class Runtime:
@@ -1092,7 +1097,8 @@ class Runtime:
                     retry_exceptions: bool = False,
                     scheduling: Optional[SchedulingStrategy] = None,
                     runtime_env: Optional[dict] = None,
-                    generator_backpressure: Optional[int] = None
+                    generator_backpressure: Optional[int] = None,
+                    generator_backpressure_bytes: Optional[int] = None
                     ) -> List[ObjectRef]:
         """ref: CoreWorker::SubmitTask core_worker.cc:1855."""
         fid = self.export_function(fn)
@@ -1108,7 +1114,8 @@ class Runtime:
             scheduling=scheduling or SchedulingStrategy(),
             runtime_env=self.resolve_runtime_env(runtime_env),
             trace_ctx=self._trace_ctx(),
-            generator_backpressure=generator_backpressure)
+            generator_backpressure=generator_backpressure,
+            generator_backpressure_bytes=generator_backpressure_bytes)
         refs = self._register_returns(spec, arg_ids)
         self._submit_spec(spec, retries_left=mr)
         if spec.is_streaming:
@@ -1195,14 +1202,16 @@ class Runtime:
         cls = (spec.scheduling_class(), target)
         q = self._queues[cls]
         q.append(spec)
-        # Bounded pumps (ref: direct_task_transport.cc lease rate limiting):
-        # a pump per submission would fire one lease request per queued
-        # task — 100k queued tasks must not mean 100k in-flight lease RPCs.
-        # Active pumps drain the whole queue via pipelining; exiting pumps
-        # respawn while work remains, so capping spawns loses no liveness.
-        active = (len(self._class_leases[cls])
-                  + self._class_pending_lease[cls])
-        if active < self._max_pumps and active < len(q):
+        # Bound PENDING LEASE REQUESTS, not live pumps (ref:
+        # direct_task_transport.cc lease rate limiting): a pump per
+        # submission would fire one lease RPC per queued task — 100k
+        # queued tasks must not mean 100k in-flight lease requests. But
+        # pumps already HOLDING leases must not suppress new ones: a pump
+        # can be parked inside a long-running push (a streaming task
+        # blocks its worker for the stream's whole lifetime), and gating
+        # on total pump count deadlocks the still-queued siblings that
+        # the consumer is waiting on.
+        if self._class_pending_lease[cls] < self._max_pumps:
             self._spawn(self._pump_class(cls))
 
     async def _enqueue_when_ready(self, spec: TaskSpec,
@@ -1226,6 +1235,14 @@ class Runtime:
         RequestNewWorkerIfNeeded + pipelining onto leased workers :588."""
         q = self._queues[cls]
         if not q:
+            return
+        # Re-check the bound HERE, on the loop (atomically w.r.t. other
+        # pumps): the spawn-time check runs on the submitting thread and
+        # reads a stale counter during bursts — a 100k-submission loop
+        # would otherwise spawn 100k pumps that all fire lease RPCs once
+        # the loop catches up. Excess pumps exit; the drain + exit-respawn
+        # path keeps liveness.
+        if self._class_pending_lease[cls] >= self._max_pumps:
             return
         self._class_pending_lease[cls] += 1
         try:
@@ -1746,23 +1763,41 @@ class Runtime:
 
     def _advance_consumed(self, st: _StreamState, index: int):
         """Consumer progress: release backpressured item acks whose
-        threshold has been reached. Called from consumer threads; waiter
+        release condition now holds. Called from consumer threads; waiter
         futures complete on the loop. The check-then-append in
         rpc_stream_item and the advance-then-filter here must each be
         atomic or a waiter registered between them is never fired."""
         with self._stream_lock:
             if index <= st.consumed:
                 return
+            # byte sweep stops at produced: no sizes exist past it, and
+            # drop_stream advances with a +1e9 sentinel that must not
+            # become a billion-iteration loop on the event-loop thread
+            for i in range(st.consumed + 1, min(index, st.produced) + 1):
+                st.ahead_bytes -= st.item_bytes.pop(i, 0)
+            if index > st.produced:
+                st.item_bytes.clear()
+                st.ahead_bytes = 0
             st.consumed = index
-            fire = [f for thr, f in st.consumed_waiters if thr <= index]
-            st.consumed_waiters = [(thr, f) for thr, f in st.consumed_waiters
-                                   if thr > index]
+            fire = [f for cond, f in st.consumed_waiters if cond()]
+            st.consumed_waiters = [(c, f) for c, f in st.consumed_waiters
+                                   if not c()]
         for f in fire:
             try:
                 self.loop.call_soon_threadsafe(
                     lambda f=f: f.done() or f.set_result(None))
             except RuntimeError:
                 pass
+
+    def drop_stream_soon(self, task_id: TaskID):
+        """GC-safe drop: ObjectRefGenerator.__del__ can fire during ANY
+        allocation — including inside a _stream_lock critical section on
+        this very thread — so the finalizer must never take the lock
+        itself. Defer to the loop thread."""
+        try:
+            self.loop.call_soon_threadsafe(self.drop_stream, task_id)
+        except RuntimeError:
+            pass   # loop already closed
 
     def drop_stream(self, task_id: TaskID):
         """Consumer discarded the generator: release any blocked executor
@@ -1784,13 +1819,17 @@ class Runtime:
 
     async def rpc_stream_item(self, task_id: TaskID, index: int, kind: str,
                               payload: Any,
-                              backpressure: Optional[int] = None) -> dict:
+                              backpressure: Optional[int] = None,
+                              backpressure_bytes: Optional[int] = None
+                              ) -> dict:
         """Executor reports one yielded item (ref: ReportGeneratorItemReturns).
         Idempotent: a retried generator re-reports earlier indices onto
         already-complete entries, which are left untouched. With
-        backpressure=N the ack is withheld until the consumer is within N
-        items of this one — the executor's blocking report call IS the
-        flow control (ref: _generator_backpressure_num_objects)."""
+        backpressure=N (items) and/or backpressure_bytes=B the ack is
+        withheld until the consumer is within the bound — the executor's
+        blocking report call IS the flow control
+        (ref: _generator_backpressure_num_objects + the streaming
+        executor's admission by object-store memory)."""
         st = self._streams.get(task_id)
         if st is None:
             return {"ok": False, "reason": "unknown-stream"}
@@ -1812,19 +1851,39 @@ class Runtime:
                 e.size = payload.get("size", 0)
             e.state = "ready"
             self._complete_entry(e)
+        size = (len(payload) if kind == "inline"
+                else int(payload.get("size", 0)))
         st.produced = max(st.produced, index)
         st.kick.set()
         fut = None
-        if backpressure is not None and not st.abandoned:
+        if (backpressure is not None or backpressure_bytes is not None) \
+                and not st.abandoned:
             with self._stream_lock:
                 # membership re-check: a concurrent drop_stream fires
                 # existing waiters and pops the state — appending to an
                 # orphaned state would wait forever
                 if self._streams.get(task_id) is not st:
                     return {"ok": False, "reason": "dropped"}
-                if index - st.consumed > backpressure:
+                if index > st.consumed and index not in st.item_bytes:
+                    st.item_bytes[index] = size
+                    st.ahead_bytes += size
+
+                def released(st=st, index=index):
+                    if backpressure is not None \
+                            and index - st.consumed > backpressure:
+                        return False
+                    if backpressure_bytes is not None \
+                            and st.ahead_bytes > backpressure_bytes \
+                            and index - st.consumed > 1:
+                        # bytes over budget: wait — unless THIS item is
+                        # the only unconsumed one (a single over-budget
+                        # block must not deadlock the stream)
+                        return False
+                    return True
+
+                if not released():
                     fut = self.loop.create_future()
-                    st.consumed_waiters.append((index - backpressure, fut))
+                    st.consumed_waiters.append((released, fut))
         if fut is not None:
             await fut
             if self._streams.get(task_id) is not st:

@@ -122,10 +122,11 @@ class Worker:
         consumer catches up — that call gets a generous deadline."""
         owner = self.runtime.pool.get(spec.owner.addr)
         bp = spec.generator_backpressure
+        bpb = spec.generator_backpressure_bytes
         return owner.call(
             "stream_item", task_id=spec.task_id, index=idx, kind=kind,
-            payload=payload, backpressure=bp,
-            timeout=3600.0 if bp is not None else 30.0)
+            payload=payload, backpressure=bp, backpressure_bytes=bpb,
+            timeout=3600.0 if (bp is not None or bpb is not None) else 30.0)
 
     def _stream_done_coro(self, spec: TaskSpec, total: int):
         return self.runtime.pool.get(spec.owner.addr).call(

@@ -24,6 +24,7 @@ Block = Union[Dict[str, np.ndarray], list]
 _DEFAULT_BLOCK_ROWS = 4096
 _WINDOW = 4  # streaming shard tasks per iterator (execution parallelism)
 _STREAM_AHEAD = 2  # blocks each shard executor may run ahead of consumption
+_ADMISSION_FRACTION = 0.25  # share of the object store unconsumed blocks may hold
 
 
 def _block_rows(b: Block) -> int:
@@ -262,9 +263,21 @@ class Dataset:
         if not refs:
             return
         w = min(_WINDOW, len(refs))
+        # Admission by object-store byte budget, not just block count
+        # (ref: streaming_executor_state.py select_operator_to_run): all
+        # shards together may hold at most ~ADMISSION_FRACTION of the
+        # store in unconsumed blocks, so huge blocks throttle production
+        # instead of spill-thrashing a small store.
+        from ray_tpu.core import runtime as _rt
+
+        r = _rt.current_runtime_or_none()
+        store_budget = (r.cfg.object_store_memory if r is not None
+                        else 2 << 30)
+        bp_bytes = max(1 << 20, int(store_budget * _ADMISSION_FRACTION / w))
 
         @ray_tpu.remote(num_returns="streaming",
-                        generator_backpressure=_STREAM_AHEAD)
+                        generator_backpressure=_STREAM_AHEAD,
+                        generator_backpressure_bytes=bp_bytes)
         def _shard_t(shard_refs, ops):
             for r in shard_refs:
                 yield _transform_block(ray_tpu.get(r), ops)

@@ -135,6 +135,23 @@ def test_cpp_client_end_to_end(gateway, tmp_path):
     assert "OK" in out
 
 
+def test_perl_client_end_to_end(gateway):
+    """Second non-Python language over the gateway (ref: the reference's
+    java/ frontend; this image ships no JVM/Go, so the proof of the
+    'gateway is the cross-language path' claim is the stock-perl client
+    in clients/perl — core modules only, same wire as cpp/)."""
+    out = subprocess.run(
+        ["perl", f"-I{REPO}/clients/perl", f"{REPO}/clients/perl/example.pl",
+         "127.0.0.1", str(gateway.port)],
+        check=True, capture_output=True, text=True, timeout=120).stdout
+    assert "put/get x=41" in out
+    assert "math:hypot(3,4) = 5" in out
+    assert "math:floor(ref) = 5" in out
+    assert "wait: 3 ready 0 pending" in out
+    assert "counter: tpu=3" in out
+    assert "OK" in out
+
+
 def test_nested_refs_and_session_cleanup(gateway):
     from ray_tpu import client
 
