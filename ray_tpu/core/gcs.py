@@ -160,6 +160,9 @@ class GcsServer:
             return
         info.alive = False
         self.available.pop(node_id, None)
+        # drop the dead node's agent-pushed stats: the dashboard must not
+        # export a frozen last sample forever
+        self.kv.pop(("node_stats", node_id.binary()), None)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         await self._publish("node", {"node_id": node_id, "alive": False})
         # Restart actors that lived there (ref: gcs_actor_manager.cc:1100).

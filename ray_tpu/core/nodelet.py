@@ -151,6 +151,8 @@ class Nodelet:
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._reap_loop())
         loop.create_task(self._log_loop())
+        if self.cfg.metrics_report_interval_s > 0:
+            loop.create_task(self._agent_loop())
         if self.spill is not None:
             loop.create_task(self._spill_loop())
         if self.cfg.memory_monitor_refresh_ms > 0:
@@ -180,6 +182,21 @@ class Nodelet:
             except (ConnectionLost, RemoteError, OSError):
                 pass
             await asyncio.sleep(period)
+
+    async def _agent_loop(self):
+        """Embedded dashboard agent (ref: dashboard/agent.py + reporter
+        module): push node+host stats to GCS KV so the dashboard head
+        aggregates with one KV scan instead of per-node fan-out."""
+        from ray_tpu.dashboard.agent import run_agent
+
+        gcs = self.pool.get(self.gcs_addr)
+
+        async def gcs_call_async(method, **kw):
+            return await gcs.call(method, timeout=5.0, **kw)
+
+        await run_agent(self, gcs_call_async,
+                        self.cfg.metrics_report_interval_s,
+                        stop_fn=lambda: self._stopping)
 
     async def _reap_loop(self):
         """Detect worker deaths; free leases; report to GCS

@@ -50,21 +50,80 @@ def _jsonable_key(k: Any):
     return str(k)
 
 
+# Single-file UI (ref: dashboard/client — a React SPA there; here a
+# dependency-free vanilla-JS app served inline, the right weight for a
+# TPU fleet console: summary cards, node/actor/job tables, auto-refresh,
+# raw API links). No build step, no npm, works from the aiohttp head.
 _INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title></head>
-<body style="font-family: monospace">
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:ui-monospace,Menlo,monospace;margin:1.2rem;background:#101418;color:#d6dde4}
+h2{margin:0 0 .8rem}  a{color:#6ab0f3}
+.cards{display:flex;gap:.8rem;flex-wrap:wrap;margin-bottom:1rem}
+.card{background:#1a2129;border:1px solid #2a333d;border-radius:6px;padding:.7rem 1rem;min-width:8.5rem}
+.card b{display:block;font-size:1.4rem}  .card span{color:#8b98a5;font-size:.8rem}
+table{border-collapse:collapse;width:100%;margin-bottom:1.2rem;font-size:.85rem}
+th,td{border-bottom:1px solid #2a333d;padding:.3rem .6rem;text-align:left}
+th{color:#8b98a5;font-weight:600}  .dead{color:#e66}  .alive{color:#7c6}
+#err{color:#e66}  footer{color:#8b98a5;font-size:.8rem}
+</style></head><body>
 <h2>ray_tpu dashboard</h2>
-<ul>
-<li><a href="/api/v0/summary">cluster summary</a></li>
-<li><a href="/api/v0/nodes">nodes</a></li>
-<li><a href="/api/v0/actors">actors</a></li>
-<li><a href="/api/v0/tasks">task events</a></li>
-<li><a href="/api/v0/jobs">jobs</a></li>
-<li><a href="/api/v0/node_stats">per-node stats</a></li>
-<li><a href="/metrics">prometheus metrics</a></li>
-<li><a href="/api/v0/logs">log files</a></li>
-</ul>
-</body></html>"""
+<div class="cards" id="cards"></div>
+<h3>nodes</h3><table id="nodes"><thead><tr>
+<th>node</th><th>state</th><th>resources</th><th>store</th><th>load</th><th>mem free</th><th>workers</th></tr></thead><tbody></tbody></table>
+<h3>actors</h3><table id="actors"><thead><tr>
+<th>actor</th><th>class</th><th>state</th><th>name</th><th>restarts</th></tr></thead><tbody></tbody></table>
+<h3>jobs</h3><table id="jobs"><thead><tr>
+<th>job</th><th>started</th><th>ended</th></tr></thead><tbody></tbody></table>
+<div id="err"></div>
+<footer>raw: <a href="/api/v0/summary">summary</a> · <a href="/api/v0/nodes">nodes</a>
+· <a href="/api/v0/actors">actors</a> · <a href="/api/v0/tasks">tasks</a>
+· <a href="/api/v0/jobs">jobs</a> · <a href="/api/v0/node_stats">node stats</a>
+· <a href="/metrics">prometheus</a> · <a href="/api/v0/logs">logs</a>
+&nbsp;|&nbsp; refreshes every 5 s</footer>
+<script>
+const fmtB=(b)=>b>1<<30?(b/2**30).toFixed(1)+"G":b>1<<20?(b/2**20).toFixed(0)+"M":b+"B";
+const cell=(t)=>{const td=document.createElement("td");td.textContent=t??"";return td};
+async function j(u){const r=await fetch(u);if(!r.ok)throw new Error(u+": "+r.status);return r.json()}
+async function tick(){
+ try{
+  const [sum,nodes,actors,jobs,stats]=await Promise.all([
+    j("/api/v0/summary"),j("/api/v0/nodes"),j("/api/v0/actors"),
+    j("/api/v0/jobs"),j("/api/v0/node_stats")]);
+  const cards=[["nodes alive",sum.nodes_alive],["nodes dead",sum.nodes_dead],
+    ["actors alive",sum.actors_alive+"/"+sum.actors_total],
+    ...Object.entries(sum.total_resources||{}).map(([k,v])=>[k,v])];
+  document.getElementById("cards").replaceChildren(...cards.map(([k,v])=>{
+    const d=document.createElement("div");d.className="card";
+    const b=document.createElement("b");b.textContent=v;
+    const s=document.createElement("span");s.textContent=k;
+    d.append(b,s);return d}));
+  const nb=document.querySelector("#nodes tbody");nb.replaceChildren();
+  for(const n of nodes){const st=stats[n.node_id]||{};const h=st.host||{};
+    const tr=document.createElement("tr");
+    const state=cell(n.alive?"ALIVE":"DEAD");state.className=n.alive?"alive":"dead";
+    tr.append(cell(n.node_id.slice(0,12)),state,
+      cell(Object.entries(n.resources).map(([k,v])=>k+":"+v).join(" ")),
+      cell(st.store_bytes!=null?fmtB(st.store_bytes)+" / "+(st.store_objects??"?")+" obj":"-"),
+      cell(h.load_1m!=null?h.load_1m.toFixed(2):"-"),
+      cell(h.mem_available!=null?fmtB(h.mem_available):"-"),
+      cell(st.workers?Object.keys(st.workers).length:"-"));
+    nb.append(tr)}
+  const ab=document.querySelector("#actors tbody");ab.replaceChildren();
+  for(const a of actors.slice(0,200)){const tr=document.createElement("tr");
+    const state=cell(a.state);state.className=a.state==="ALIVE"?"alive":(a.state==="DEAD"?"dead":"");
+    tr.append(cell((a.actor_id||"").slice(0,12)),cell(a.class_name),state,
+      cell(a.name||""),cell(a.num_restarts));ab.append(tr)}
+  const jb=document.querySelector("#jobs tbody");jb.replaceChildren();
+  for(const job of jobs.slice(0,100)){const tr=document.createElement("tr");
+    tr.append(cell((job.job_id||"").slice(0,12)),
+      cell(job.start?new Date(job.start*1000).toLocaleTimeString():""),
+      cell(job.end?new Date(job.end*1000).toLocaleTimeString():"running"));
+    jb.append(tr)}
+  document.getElementById("err").textContent="";
+ }catch(e){document.getElementById("err").textContent=String(e)}
+}
+tick();setInterval(tick,5000);
+</script></body></html>"""
 
 
 class DashboardHead:
@@ -131,18 +190,41 @@ class DashboardHead:
         })
 
     async def _h_node_stats(self, request):
-        nodes = [n for n in await self._gcs("get_nodes") if n.alive]
+        """Aggregated from the per-node agents' pushes (GCS KV
+        ns=node_stats) — ONE KV scan regardless of cluster size, instead
+        of a live RPC fan-out to every nodelet (ref: reporter agents
+        pushing to the head). `?live=1` forces the old direct fan-out for
+        debugging a wedged agent."""
+        if request.query.get("live") == "1":
+            nodes = [n for n in await self._gcs("get_nodes") if n.alive]
 
-        async def one(n):
-            try:
-                return await self.pool.get(tuple(n.nodelet_addr)).call(
-                    "node_stats", timeout=5.0)
-            except Exception as e:  # noqa: BLE001 — per-node best effort
-                return {"error": str(e)}
+            async def one(n):
+                try:
+                    return await self.pool.get(tuple(n.nodelet_addr)).call(
+                        "node_stats", timeout=5.0)
+                except Exception as e:  # noqa: BLE001 — best effort
+                    return {"error": str(e)}
 
-        stats = await asyncio.gather(*(one(n) for n in nodes))
-        return self._json({n.node_id.hex(): st
-                           for n, st in zip(nodes, stats)})
+            stats = await asyncio.gather(*(one(n) for n in nodes))
+            return self._json({n.node_id.hex(): st
+                               for n, st in zip(nodes, stats)})
+        try:
+            out = await self._scan_node_stats()
+        except Exception as e:   # noqa: BLE001
+            out = {"error": str(e)}
+        return self._json(out)
+
+    async def _scan_node_stats(self) -> dict:
+        """node_id hex -> last agent sample, concurrent kv_gets (one
+        round-trip wave, not N serial), dead nodes filtered out."""
+        alive = {n.node_id.binary()
+                 for n in await self._gcs("get_nodes") if n.alive}
+        keys = [k for k in await self._gcs("kv_keys", ns="node_stats")
+                if k in alive]
+        raws = await asyncio.gather(
+            *(self._gcs("kv_get", ns="node_stats", key=k) for k in keys))
+        return {k.hex(): json.loads(raw)
+                for k, raw in zip(keys, raws) if raw}
 
     async def _h_metrics(self, request):
         """Prometheus exposition (ref: dashboard/modules/metrics/ +
@@ -154,15 +236,69 @@ class DashboardHead:
         lines = []
         try:
             keys = await self._gcs("kv_keys", ns="metrics")
-            for key in keys:
-                raw = await self._gcs("kv_get", ns="metrics", key=key)
+            raws = await asyncio.gather(
+                *(self._gcs("kv_get", ns="metrics", key=k) for k in keys))
+            for key, raw in zip(keys, raws):
                 if raw is None:
                     continue
                 lines.extend(render_prometheus(key.decode(), json.loads(raw)))
         except Exception as e:  # noqa: BLE001
             lines.append(f"# metrics collection error: {e}")
+        try:
+            lines.extend(await self._system_series())
+        except Exception as e:  # noqa: BLE001
+            lines.append(f"# system series error: {e}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    async def _system_series(self) -> list:
+        """System metrics derived from the per-node agent pushes + GCS
+        state (ref: metric_defs.h system gauges flowing through the
+        metrics agent). These are the series the generated Grafana
+        dashboard (dashboard/grafana.py) graphs."""
+        out = []
+
+        def g(name, help_, pairs):
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} gauge")
+            for tags, v in pairs:
+                label = ",".join(f'{k}="{v2}"' for k, v2 in
+                                 sorted(tags.items()))
+                out.append(f"{name}{{{label}}} {v}" if label
+                           else f"{name} {v}")
+
+        stats = {nid[:12]: s
+                 for nid, s in (await self._scan_node_stats()).items()}
+        g("raytpu_object_store_bytes_in_use", "shm store bytes per node",
+          [({"node": n}, s.get("store_bytes", 0))
+           for n, s in stats.items()])
+        g("raytpu_object_store_num_objects", "store objects per node",
+          [({"node": n}, s.get("store_objects", 0))
+           for n, s in stats.items()])
+        g("raytpu_spilled_bytes_total", "bytes spilled per node",
+          [({"node": n}, s.get("spilled_bytes", 0))
+           for n, s in stats.items()])
+        g("raytpu_workers_alive", "workers per node",
+          [({"node": n}, len(s.get("workers", {})))
+           for n, s in stats.items()])
+        g("raytpu_pending_leases", "queued lease requests per node",
+          [({"node": n}, s.get("pending_leases", 0))
+           for n, s in stats.items()])
+        g("raytpu_oom_kills_total", "OOM kills per node",
+          [({"node": n}, s.get("oom_kills", 0)) for n, s in stats.items()])
+        g("raytpu_node_load_1m", "host 1m load per node",
+          [({"node": n}, s.get("host", {}).get("load_1m", 0))
+           for n, s in stats.items()])
+        g("raytpu_node_mem_available_bytes", "host available memory",
+          [({"node": n}, s.get("host", {}).get("mem_available", 0))
+           for n, s in stats.items()])
+        actors = await self._gcs("list_actors")
+        g("raytpu_actors_alive", "actors in ALIVE state",
+          [({}, sum(1 for a in actors if a["state"] == "ALIVE"))])
+        nodes = await self._gcs("get_nodes")
+        g("raytpu_nodes_alive", "cluster nodes alive",
+          [({}, sum(1 for n in nodes if n.alive))])
+        return out
 
     async def _h_logs(self, request):
         """List/serve session log files (ref: dashboard log module)."""

@@ -63,17 +63,66 @@ def test_nodes_actors_tasks(dash):
 
 
 def test_node_stats_and_metrics(dash):
+    import time
+
     from ray_tpu.util.metrics import Counter
 
     c = Counter("dash_test_counter", description="test counter")
     c.inc(3.0)
 
-    _, _, body = _get(dash + "/api/v0/node_stats")
-    stats = json.loads(body)
-    assert len(stats) >= 1
+    # agent-pushed stats land in GCS KV within one report interval
+    deadline = time.time() + 30
+    stats = {}
+    while time.time() < deadline:
+        _, _, body = _get(dash + "/api/v0/node_stats")
+        stats = json.loads(body)
+        if stats and "error" not in stats:
+            break
+        time.sleep(1.0)
+    assert stats and "error" not in stats
     first = next(iter(stats.values()))
     assert "available" in first
+    assert "host" in first and "mem_total" in first["host"]
+    assert "collected_at" in first
+
+    # live fan-out fallback still answers
+    _, _, body = _get(dash + "/api/v0/node_stats?live=1")
+    live = json.loads(body)
+    assert live and "available" in next(iter(live.values()))
 
     status, ctype, body = _get(dash + "/metrics")
     assert status == 200 and ctype == "text/plain"
     assert "dash_test_counter" in body
+    # system series derived from the agent pushes
+    assert "raytpu_object_store_bytes_in_use" in body
+    assert "raytpu_nodes_alive" in body
+    assert "raytpu_node_load_1m" in body
+
+
+def test_ui_served(dash):
+    status, ctype, body = _get(dash + "/")
+    assert status == 200 and ctype == "text/html"
+    # the UI is an app, not a link list: tables + auto-refresh fetches
+    for needle in ("id=\"cards\"", "api/v0/node_stats", "setInterval"):
+        assert needle in body
+
+
+def test_grafana_provisioning(tmp_path):
+    import json as _json
+
+    from ray_tpu.dashboard.grafana import generate_dashboard, provision
+
+    files = provision(str(tmp_path), head_addr="127.0.0.1:1234")
+    names = {f.split(str(tmp_path) + "/")[-1] for f in files}
+    assert names == {"prometheus.yml",
+                     "grafana/provisioning/datasources/raytpu.yaml",
+                     "grafana/provisioning/dashboards/raytpu.yaml",
+                     "dashboards/raytpu-cluster.json"}
+    dash = _json.loads((tmp_path / "dashboards" /
+                        "raytpu-cluster.json").read_text())
+    assert dash["uid"] == "raytpu-cluster"
+    assert len(dash["panels"]) >= 8
+    exprs = {p["targets"][0]["expr"] for p in dash["panels"]}
+    # every panel graphs a series the head actually exports
+    assert "raytpu_object_store_bytes_in_use" in exprs
+    assert "127.0.0.1:1234" in (tmp_path / "prometheus.yml").read_text()
