@@ -38,6 +38,9 @@ from ray_tpu.rl.policy_server import (ExternalPPOConfig, ExternalPPOTrainer,
 from ray_tpu.rl.ppo import PPOConfig, PPOTrainer
 from ray_tpu.rl.sac import SACConfig, SACTrainer
 from ray_tpu.rl.td3 import TD3Config, TD3Trainer
+from ray_tpu.rl.pg import PGConfig, PGTrainer
+from ray_tpu.rl.a3c import A3CConfig, A3CTrainer
+from ray_tpu.rl.marwil import MARWILConfig, MARWILTrainer
 
 _REGISTRY = {
     "PPO": (PPOConfig, PPOTrainer),
@@ -56,6 +59,9 @@ _REGISTRY = {
     "ARS": (ARSConfig, ARSTrainer),
     "BanditLinUCB": (BanditConfig, LinUCBTrainer),
     "BanditLinTS": (BanditConfig, LinTSTrainer),
+    "PG": (PGConfig, PGTrainer),
+    "A3C": (A3CConfig, A3CTrainer),
+    "MARWIL": (MARWILConfig, MARWILTrainer),
 }
 
 
@@ -76,6 +82,8 @@ __all__ = [
     "BCConfig", "BCTrainer", "CQLConfig", "CQLTrainer",
     "MultiAgentEnv", "MultiAgentPPOConfig", "MultiAgentPPOTrainer",
     "register_multi_agent_env",
+    "PGConfig", "PGTrainer", "A3CConfig", "A3CTrainer",
+    "MARWILConfig", "MARWILTrainer",
     "Learner", "LearnerGroup", "LearnerSpec",
     "Connector", "ConnectorPipeline", "NormalizeObs", "FrameStack",
     "FlattenObs", "ClipObs",

@@ -14,7 +14,7 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, probe_env_spec
+from ray_tpu.rl.core import Algorithm, probe_env_spec, rollout_result
 from ray_tpu.rl.ppo import (RolloutWorker, compute_gae, init_policy,
                             policy_forward)
 
@@ -33,6 +33,27 @@ class A2CConfig:
     grad_clip: float = 0.5
     hidden: int = 64
     seed: int = 0
+
+
+def make_a2c_loss(vf_coeff: float, entropy_coeff: float):
+    """The advantage actor-critic loss shared by A2C (sync) and A3C
+    (async): policy gradient + value regression - entropy bonus."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, mb):
+        logits, values = policy_forward(params, mb["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, mb["actions"][:, None], axis=-1)[:, 0]
+        pg_loss = -(logp * mb["adv"]).mean()
+        vf_loss = jnp.square(values - mb["returns"]).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    return loss_fn
 
 
 class A2CTrainer(Algorithm):
@@ -63,19 +84,7 @@ class A2CTrainer(Algorithm):
         import optax
 
         cfg = self.config
-
-        def loss_fn(params, mb):
-            logits, values = policy_forward(params, mb["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, mb["actions"][:, None], axis=-1)[:, 0]
-            pg_loss = -(logp * mb["adv"]).mean()
-            vf_loss = jnp.square(values - mb["returns"]).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = (pg_loss + cfg.vf_coeff * vf_loss
-                     - cfg.entropy_coeff * entropy)
-            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
+        loss_fn = make_a2c_loss(cfg.vf_coeff, cfg.entropy_coeff)
 
         def update(params, opt_state, mb):
             (loss, aux), grads = jax.value_and_grad(
@@ -111,14 +120,7 @@ class A2CTrainer(Algorithm):
             self.params, self.opt_state, mb)
 
         stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
-        eps_done = [s for s in stats if s["episodes"]]
-        return {
-            "timesteps_total": self.timesteps,
-            "episode_return_mean": float(np.mean(
-                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
-            "episodes_total": sum(s["episodes"] for s in stats),
-            **{k: float(v) for k, v in aux.items()},
-        }
+        return rollout_result(self.timesteps, stats, aux)
 
     def get_weights(self):
         return self.params

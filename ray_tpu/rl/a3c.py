@@ -1,0 +1,166 @@
+"""A3C: asynchronous advantage actor-critic.
+
+Reference: rllib_contrib a3c (rllib/algorithms/a3c before exile) — the
+asynchronous counterpart of A2C: each worker computes GRADIENTS on its
+own rollout against a (possibly stale) snapshot of the parameters, and
+the learner applies them as they arrive, first come first served, instead
+of synchronizing a fleet-wide batch. Here each A3CWorker actor holds its
+env plus a jitted grad function; the learner drives an async loop with
+ray_tpu.wait(num_returns=1), applying each gradient and immediately
+re-dispatching the worker with fresh weights (the Hogwild schedule with a
+centralized apply — on TPU the single device is the natural parameter
+server).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.a2c import make_a2c_loss
+from ray_tpu.rl.core import Algorithm, probe_env_spec, rollout_result
+from ray_tpu.rl.ppo import RolloutWorker, compute_gae, init_policy
+
+
+@dataclass
+class A3CConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 64
+    grads_per_step: int = 4          # async applies per training_step
+    lr: float = 7e-4
+    gamma: float = 0.99
+    lam: float = 1.0
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 0.5
+    grad_timeout_s: float = 300.0    # per-wait bound on a worker gradient
+    hidden: int = 64
+    seed: int = 0
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class A3CWorker:
+    """Env + local gradient computation (ref: a3c worker loop). Reuses
+    the PPO rollout machinery; the gradient of the A2C loss is computed
+    worker-side so only grads travel to the learner."""
+
+    def __init__(self, env: str, seed: int, env_config: dict,
+                 cfg_dict: dict):
+        import jax
+
+        self.inner = RolloutWorker._cls(env, seed, env_config)
+        self.cfg = cfg_dict
+        self._grad = jax.jit(self._make_grad())
+
+    def _make_grad(self):
+        import jax
+
+        loss_fn = make_a2c_loss(self.cfg["vf_coeff"],
+                                self.cfg["entropy_coeff"])
+
+        def grad(params, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            return grads, {"loss": loss, **aux}
+
+        return grad
+
+    def sample_grad(self, params, n_steps: int):
+        b = self.inner.sample(params, n_steps)
+        adv, ret = compute_gae(b, self.cfg["gamma"], self.cfg["lam"])
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        mb = {"obs": b["obs"], "actions": b["actions"],
+              "adv": adv.astype(np.float32),
+              "returns": ret.astype(np.float32)}
+        import jax
+
+        grads, aux = self._grad(params, mb)
+        return (jax.device_get(grads),
+                {k: float(v) for k, v in aux.items()},
+                len(adv))
+
+    def episode_stats(self):
+        return self.inner.episode_stats()
+
+
+class A3CTrainer(Algorithm):
+    def _setup(self, cfg: A3CConfig):
+        import jax
+        import optax
+
+        obs_dim, n_actions, _a, _h = probe_env_spec(cfg.env, cfg.env_config)
+        assert n_actions is not None, "A3C here supports discrete actions"
+        self.params = init_policy(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                  n_actions, cfg.hidden)
+        self.opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                               optax.rmsprop(cfg.lr, decay=0.99, eps=1e-5))
+        self.opt_state = self.opt.init(self.params)
+        cfg_dict = {"gamma": cfg.gamma, "lam": cfg.lam,
+                    "vf_coeff": cfg.vf_coeff,
+                    "entropy_coeff": cfg.entropy_coeff}
+        self.workers = [
+            A3CWorker.remote(cfg.env, cfg.seed + i * 1000, cfg.env_config,
+                             cfg_dict)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        # persistent in-flight map: leftover gradients carry over to the
+        # next step (abandoning them would waste the worker's rollout AND
+        # queue the next dispatch behind it)
+        self._inflight = {}
+        self._apply = jax.jit(self._make_apply())
+
+    def _make_apply(self):
+        import optax
+
+        def apply(params, opt_state, grads):
+            upd, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, upd), opt_state
+
+        return apply
+
+    def training_step(self) -> Dict[str, Any]:
+        """The async loop: keep one gradient task in flight per worker,
+        apply WHICHEVER lands first and re-dispatch that worker with the
+        fresh weights (others keep computing on stale params — that
+        staleness is A3C). The in-flight map persists across steps, so
+        no rollout compute is ever discarded."""
+        import jax
+
+        cfg = self.config
+        dispatched = {id(w) for _r, w in self._inflight.values()}
+        for w in self.workers:
+            if id(w) not in dispatched:
+                ref = w.sample_grad.remote(jax.device_get(self.params),
+                                           cfg.rollout_fragment_length)
+                self._inflight[ref.id.binary()] = (ref, w)
+        aux_last = {}
+        for _ in range(cfg.grads_per_step):
+            ready, _ = ray_tpu.wait(
+                [r for r, _w in self._inflight.values()],
+                num_returns=1, timeout=cfg.grad_timeout_s)
+            if not ready:
+                raise TimeoutError(
+                    f"no worker gradient within {cfg.grad_timeout_s}s "
+                    "(env too slow? raise A3CConfig.grad_timeout_s)")
+            ref = ready[0]
+            _, w = self._inflight.pop(ref.id.binary())
+            grads, aux_last, n = ray_tpu.get(ref)
+            self.params, self.opt_state = self._apply(
+                self.params, self.opt_state, grads)
+            self.timesteps += n
+            new_ref = w.sample_grad.remote(jax.device_get(self.params),
+                                           cfg.rollout_fragment_length)
+            self._inflight[new_ref.id.binary()] = (new_ref, w)
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        return rollout_result(self.timesteps, stats, aux_last)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = weights

@@ -29,6 +29,38 @@ def dense_init(key, i, o, scale: float = None):
             "b": jax.numpy.zeros((o,))}
 
 
+def reward_to_go(batch_or_rewards, gamma: float, dones=None):
+    """Discounted reward-to-go, resetting at dones (shared by PG/MARWIL;
+    ref: postprocessing.compute_advantages with use_critic=False)."""
+    import numpy as np
+
+    if dones is None:
+        rews = batch_or_rewards["rewards"]
+        dones = batch_or_rewards["dones"]
+    else:
+        rews = batch_or_rewards
+    out = np.zeros_like(rews, dtype=np.float32)
+    running = 0.0
+    for t in range(len(rews) - 1, -1, -1):
+        running = rews[t] + gamma * running * (1.0 - dones[t])
+        out[t] = running
+    return out
+
+
+def rollout_result(timesteps_total: int, worker_stats, aux) -> dict:
+    """The standard on-policy result dict (shared by A2C/A3C/PG)."""
+    import numpy as np
+
+    eps_done = [s for s in worker_stats if s["episodes"]]
+    return {
+        "timesteps_total": timesteps_total,
+        "episode_return_mean": float(np.mean(
+            [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+        "episodes_total": sum(s["episodes"] for s in worker_stats),
+        **{k: float(v) for k, v in aux.items()},
+    }
+
+
 def mlp_init(key, sizes: List[int], out_scale: float = None):
     import jax
 

@@ -1,0 +1,152 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Reference: rllib/algorithms/marwil — offline imitation where each
+behavior-cloning term is weighted by exp(beta * advantage): good
+demonstrated actions are imitated harder than bad ones, and beta=0
+degrades exactly to BC (the reference implements BC as MARWIL beta=0;
+here BC is the standalone ray_tpu.rl.offline.BCTrainer and MARWIL adds
+the advantage machinery on the same offline mixin).
+
+The advantage is reward-to-go minus a learned value baseline, both
+estimated from the offline transitions; the value net trains jointly
+with the policy (squared error to the Monte-Carlo returns), and the
+advantage scale is tracked with a running moving average as in the
+reference (marwil.py's moving-average normalizer c^2 update).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl.core import (Algorithm, mlp_forward, mlp_init,
+                             reward_to_go)
+from ray_tpu.rl.offline import _OfflineMixin
+
+
+@dataclass
+class MARWILConfig:
+    dataset: Any = None              # {"obs","actions","rewards","dones"}
+    discrete: bool = True
+    beta: float = 1.0                # 0 => plain BC
+    gamma: float = 0.99
+    obs_dim: int = 0
+    n_actions: int = 0
+    act_dim: int = 0
+    lr: float = 1e-3
+    vf_coeff: float = 1.0
+    moving_average_decay: float = 0.99   # advantage-norm c^2 tracker
+    train_batch_size: int = 256
+    updates_per_iter: int = 32
+    hidden: int = 128
+    seed: int = 0
+
+
+class MARWILTrainer(_OfflineMixin, Algorithm):
+    def _setup(self, cfg: MARWILConfig):
+        import jax
+        import optax
+
+        assert cfg.dataset is not None, "MARWIL needs an offline dataset"
+        self._init_data(cfg.dataset, cfg.train_batch_size, cfg.seed)
+        for need in ("rewards", "dones"):
+            assert need in self.data, f"MARWIL dataset needs {need!r}"
+        self.data["returns"] = reward_to_go(
+            np.asarray(self.data["rewards"], np.float32), cfg.gamma,
+            dones=np.asarray(self.data["dones"], np.float32))
+        obs_dim = cfg.obs_dim or int(self.data["obs"].shape[-1])
+        if cfg.discrete:
+            n_out = cfg.n_actions or int(self.data["actions"].max()) + 1
+        else:
+            n_out = 2 * (cfg.act_dim or int(self.data["actions"].shape[-1]))
+        k1, k2 = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.params = {
+            "pi": mlp_init(k1, [obs_dim, cfg.hidden, cfg.hidden, n_out],
+                           out_scale=0.01),
+            "vf": mlp_init(k2, [obs_dim, cfg.hidden, cfg.hidden, 1],
+                           out_scale=0.01),
+        }
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.c2 = 1.0                 # moving average of squared advantage
+        self.workers = []
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(params, mb, c):
+            values = mlp_forward(params["vf"], mb["obs"])[:, 0]
+            adv = mb["returns"] - values
+            vf_loss = jnp.square(adv).mean()
+            # re-weight imitation by exp(beta * normalized advantage);
+            # stop-gradient: the policy must not inflate weights by
+            # corrupting the baseline (ref: marwil surrogate)
+            # exponent capped: before the c^2 normalizer warms up, raw
+            # advantages can overflow the exp
+            w = jnp.exp(jnp.minimum(
+                cfg.beta * jax.lax.stop_gradient(adv) / c, 5.0))
+            out = mlp_forward(params["pi"], mb["obs"])
+            if cfg.discrete:
+                logp_all = jax.nn.log_softmax(out)
+                logp = jnp.take_along_axis(
+                    logp_all, mb["actions"][:, None].astype(jnp.int32),
+                    axis=-1)[:, 0]
+                acc = (out.argmax(-1) == mb["actions"]).mean()
+                aux = {"accuracy": acc}
+            else:
+                mu, log_std = jnp.split(out, 2, axis=-1)
+                log_std = jnp.clip(log_std, -5.0, 2.0)
+                logp = -(0.5 * jnp.square((mb["actions"] - mu)
+                                          / jnp.exp(log_std))
+                         + log_std).sum(-1)
+                aux = {"mse": jnp.square(mu - mb["actions"]).mean()}
+            pi_loss = -(w * logp).mean()
+            total = pi_loss + cfg.vf_coeff * vf_loss
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "mean_weight": w.mean(),
+                           "adv_sq": jnp.square(adv).mean(), **aux}
+
+        def update(params, opt_state, mb, c):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb, c)
+            upd, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, upd)
+            return params, opt_state, {"loss": loss, **aux}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        aux = {}
+        for _ in range(cfg.updates_per_iter):
+            mb = self._minibatch()
+            c = float(np.sqrt(self.c2) + 1e-8)
+            self.params, self.opt_state, aux = self._update(
+                self.params, self.opt_state, mb, c)
+            d = cfg.moving_average_decay
+            self.c2 = d * self.c2 + (1 - d) * float(aux["adv_sq"])
+        return {"c": float(np.sqrt(self.c2)),
+                **{k: float(v) for k, v in aux.items()}}
+
+    def compute_action(self, obs: np.ndarray):
+        import jax.numpy as jnp
+
+        out = np.asarray(mlp_forward(self.params["pi"],
+                                     jnp.asarray(obs[None])))[0]
+        if self.config.discrete:
+            return int(out.argmax(-1))
+        mu, _ = np.split(out, 2, axis=-1)
+        return mu
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = weights

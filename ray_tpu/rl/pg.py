@@ -1,0 +1,106 @@
+"""PG: vanilla policy gradient (REINFORCE).
+
+Reference: rllib/algorithms/pg (pre-exile) — Monte-Carlo reward-to-go
+returns, no critic, one gradient step per sampled batch. The simplest
+on-policy baseline in the zoo; reuses PPO's discrete policy net and
+rollout workers (the value head exists in the shared net but carries no
+loss here, matching PG's critic-free objective).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import (Algorithm, probe_env_spec,
+                             reward_to_go, rollout_result)
+from ray_tpu.rl.ppo import RolloutWorker, init_policy, policy_forward
+
+
+@dataclass
+class PGConfig:
+    env: str = "CartPole-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 100
+    lr: float = 4e-3
+    gamma: float = 0.99
+    hidden: int = 64
+    seed: int = 0
+
+
+class PGTrainer(Algorithm):
+    """ref: pg.py training_step — sample, compute returns, one policy
+    gradient step on -logp * R."""
+
+    def _setup(self, cfg: PGConfig):
+        import jax
+        import optax
+
+        obs_dim, n_actions, _a, _h = probe_env_spec(cfg.env, cfg.env_config)
+        assert n_actions is not None, "PG here supports discrete actions"
+        self.params = init_policy(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                  n_actions, cfg.hidden)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.workers = [
+            RolloutWorker.options(num_cpus=0.5).remote(
+                cfg.env, cfg.seed + i * 1000, cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        def loss_fn(params, mb):
+            logits, _values = policy_forward(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=-1)[:, 0]
+            pg_loss = -(logp * mb["returns"]).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            return pg_loss, {"entropy": entropy}
+
+        def update(params, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            upd, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, upd)
+            return params, opt_state, {"loss": loss, **aux}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        params_host = jax.device_get(self.params)
+        batches = ray_tpu.get([
+            w.sample.remote(params_host, cfg.rollout_fragment_length)
+            for w in self.workers])
+        obs, actions, rets = [], [], []
+        for b in batches:
+            obs.append(b["obs"])
+            actions.append(b["actions"])
+            rets.append(reward_to_go(b, cfg.gamma))
+        ret = np.concatenate(rets)
+        ret = (ret - ret.mean()) / (ret.std() + 1e-8)
+        mb = {"obs": np.concatenate(obs),
+              "actions": np.concatenate(actions), "returns": ret}
+        self.timesteps += len(ret)
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, mb)
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        return rollout_result(self.timesteps, stats, aux)
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = weights

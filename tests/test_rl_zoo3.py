@@ -1,0 +1,130 @@
+"""Round-2 algorithm additions: PG (REINFORCE), A3C (async grads),
+MARWIL (advantage-weighted imitation). Same smoke-level contract as the
+rest of the zoo: a few training steps run, metrics are finite, weights
+move, and learning signals point the right way."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _tree_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def test_pg_trains(cluster):
+    from ray_tpu.rl import PGConfig, PGTrainer
+
+    t = PGTrainer(PGConfig(num_rollout_workers=2,
+                           rollout_fragment_length=64))
+    try:
+        import jax
+
+        w0 = jax.device_get(t.get_weights())
+        r = t.train()
+        assert r["timesteps_total"] == 128
+        assert np.isfinite(r["loss"]) and np.isfinite(r["entropy"])
+        assert not _tree_equal(t.get_weights(), w0)
+    finally:
+        t.stop()
+
+
+def test_a3c_trains_async(cluster):
+    from ray_tpu.rl import A3CConfig, A3CTrainer
+
+    t = A3CTrainer(A3CConfig(num_rollout_workers=2,
+                             rollout_fragment_length=32,
+                             grads_per_step=4))
+    try:
+        import jax
+
+        w0 = jax.device_get(t.get_weights())
+        r = t.train()
+        # 4 async applies x 32-step fragments
+        assert r["timesteps_total"] == 4 * 32
+        assert np.isfinite(r["loss"])
+        assert not _tree_equal(t.get_weights(), w0)
+        r2 = t.train()
+        assert r2["timesteps_total"] == 8 * 32
+    finally:
+        t.stop()
+
+
+def _offline_discrete_data(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(n, 4)).astype(np.float32)
+    good = (obs[:, 0] > 0).astype(np.int64)
+    # half the actions follow the good rule (rewarded), half are noise
+    noise = rng.integers(0, 2, n)
+    follow = rng.random(n) < 0.5
+    actions = np.where(follow, good, noise)
+    rewards = (actions == good).astype(np.float32)
+    # bandit-style episodes (every row terminal): returns == rewards, so
+    # the advantage signal is exactly the per-action reward — the rows
+    # are iid, a synthetic multi-step ordering would only inject noise
+    dones = np.ones(n, np.float32)
+    return {"obs": obs, "actions": actions, "rewards": rewards,
+            "dones": dones}
+
+
+def test_marwil_upweights_good_actions():
+    """The defining MARWIL property, asserted mechanically: after
+    training, imitation weights exp(beta*adv/c) are systematically
+    higher for rewarded transitions than unrewarded ones, and beta=0
+    collapses to plain BC (all weights exactly 1)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl import MARWILConfig, MARWILTrainer
+    from ray_tpu.rl.core import mlp_forward
+
+    data = _offline_discrete_data()
+    good = (data["obs"][:, 0] > 0).astype(np.int64)
+
+    t = MARWILTrainer(MARWILConfig(dataset=data, beta=1.0,
+                                   updates_per_iter=64))
+    r = None
+    for _ in range(6):
+        r = t.train()
+    assert np.isfinite(r["loss"]) and np.isfinite(r["mean_weight"])
+    assert r["accuracy"] > 0.6        # still imitates the majority signal
+
+    # recompute the weights the loss used: rewarded samples must carry
+    # more imitation mass than unrewarded ones
+    values = np.asarray(mlp_forward(t.params["vf"],
+                                    jnp.asarray(data["obs"])))[:, 0]
+    adv = t.data["returns"] - values
+    c = float(np.sqrt(t.c2) + 1e-8)
+    w = np.exp(np.minimum(1.0 * adv / c, 5.0))
+    rewarded = data["rewards"] > 0.5
+    assert w[rewarded].mean() > w[~rewarded].mean() * 1.05, \
+        "advantage weighting does not favor rewarded actions"
+
+    # beta=0 is exactly BC: every weight is 1
+    t0 = MARWILTrainer(MARWILConfig(dataset=data, beta=0.0,
+                                    updates_per_iter=8))
+    r0 = t0.train()
+    assert abs(r0["mean_weight"] - 1.0) < 1e-6
+
+    a = t.compute_action(data["obs"][0])
+    assert a in (0, 1)
+
+
+def test_registry_has_new_algorithms():
+    from ray_tpu.rl import get_algorithm
+
+    for name in ("PG", "A3C", "MARWIL"):
+        cfg_cls, trainer_cls = get_algorithm(name)
+        assert cfg_cls is not None and trainer_cls is not None
