@@ -41,6 +41,19 @@ from ray_tpu.rl.td3 import TD3Config, TD3Trainer
 from ray_tpu.rl.pg import PGConfig, PGTrainer
 from ray_tpu.rl.a3c import A3CConfig, A3CTrainer
 from ray_tpu.rl.marwil import MARWILConfig, MARWILTrainer
+from ray_tpu.rl.apex import ApexDDPGConfig, ApexDDPGTrainer
+from ray_tpu.rl.ddppo import DDPPOConfig, DDPPOTrainer
+from ray_tpu.rl.offline import CRRConfig, CRRTrainer
+from ray_tpu.rl.r2d2 import R2D2Config, R2D2Trainer
+from ray_tpu.rl.simple_q import (RandomAgentConfig, RandomAgentTrainer,
+                                 SimpleQConfig, SimpleQTrainer)
+from ray_tpu.rl.qmix import QMIXConfig, QMIXTrainer, TwoStepGame
+from ray_tpu.rl.maddpg import LineSpreadEnv, MADDPGConfig, MADDPGTrainer
+from ray_tpu.rl.dt import DTConfig, DTTrainer
+from ray_tpu.rl.alpha_zero import (AlphaZeroConfig, AlphaZeroTrainer,
+                                   TicTacToe)
+from ray_tpu.rl.maml import MAMLConfig, MAMLTrainer, PointGoalEnv
+from ray_tpu.rl.slateq import SlateQConfig, SlateQTrainer, SlateRecEnv
 
 _REGISTRY = {
     "PPO": (PPOConfig, PPOTrainer),
@@ -62,6 +75,18 @@ _REGISTRY = {
     "PG": (PGConfig, PGTrainer),
     "A3C": (A3CConfig, A3CTrainer),
     "MARWIL": (MARWILConfig, MARWILTrainer),
+    "SimpleQ": (SimpleQConfig, SimpleQTrainer),
+    "RandomAgent": (RandomAgentConfig, RandomAgentTrainer),
+    "R2D2": (R2D2Config, R2D2Trainer),
+    "CRR": (CRRConfig, CRRTrainer),
+    "ApexDDPG": (ApexDDPGConfig, ApexDDPGTrainer),
+    "DDPPO": (DDPPOConfig, DDPPOTrainer),
+    "QMIX": (QMIXConfig, QMIXTrainer),
+    "MADDPG": (MADDPGConfig, MADDPGTrainer),
+    "DT": (DTConfig, DTTrainer),
+    "AlphaZero": (AlphaZeroConfig, AlphaZeroTrainer),
+    "MAML": (MAMLConfig, MAMLTrainer),
+    "SlateQ": (SlateQConfig, SlateQTrainer),
 }
 
 
@@ -84,6 +109,15 @@ __all__ = [
     "register_multi_agent_env",
     "PGConfig", "PGTrainer", "A3CConfig", "A3CTrainer",
     "MARWILConfig", "MARWILTrainer",
+    "SimpleQConfig", "SimpleQTrainer", "RandomAgentConfig",
+    "RandomAgentTrainer", "R2D2Config", "R2D2Trainer",
+    "CRRConfig", "CRRTrainer", "ApexDDPGConfig", "ApexDDPGTrainer",
+    "DDPPOConfig", "DDPPOTrainer",
+    "QMIXConfig", "QMIXTrainer", "TwoStepGame",
+    "MADDPGConfig", "MADDPGTrainer", "LineSpreadEnv",
+    "DTConfig", "DTTrainer", "AlphaZeroConfig", "AlphaZeroTrainer",
+    "TicTacToe", "MAMLConfig", "MAMLTrainer", "PointGoalEnv",
+    "SlateQConfig", "SlateQTrainer", "SlateRecEnv",
     "Learner", "LearnerGroup", "LearnerSpec",
     "Connector", "ConnectorPipeline", "NormalizeObs", "FrameStack",
     "FlattenObs", "ClipObs",

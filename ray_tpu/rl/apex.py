@@ -16,7 +16,8 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, ReplayBuffer, probe_env_spec
+from ray_tpu.rl.core import (Algorithm, ReplayBuffer, probe_env_spec,
+                             rollout_result)
 from ray_tpu.rl.dqn import _EpsilonWorker, init_qnet, q_forward
 
 
@@ -245,6 +246,183 @@ class ApexDQNTrainer(Algorithm):
         import jax
 
         self.net = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, weights)
+
+    def stop(self):
+        for a in self.workers + self.shards:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+@dataclass
+class ApexDDPGConfig:
+    env: str = "Pendulum-v1"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 3
+    num_replay_shards: int = 1
+    rollout_fragment_length: int = 50
+    replay_capacity: int = 100_000
+    learning_starts: int = 300
+    train_batch_size: int = 128
+    updates_per_iter: int = 16
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    # per-worker exploration-noise ladder (ref: apex_ddpg.py
+    # per_worker_exploration — each worker explores at its own scale)
+    noise_base: float = 0.2
+    prioritized_alpha: float = 0.6
+    prioritized_beta: float = 0.4
+    hidden: int = 128
+    seed: int = 0
+
+
+class ApexDDPGTrainer(Algorithm):
+    """APEX-DDPG: the ApexDQN fan-in architecture with a DDPG learner
+    (ref: rllib/algorithms/apex_ddpg/apex_ddpg.py — continuous-action
+    APEX: prioritized distributed replay, per-worker exploration noise,
+    deterministic actor + Q critic with polyak targets)."""
+
+    def _setup(self, cfg: ApexDDPGConfig):
+        import jax
+        import optax
+
+        from ray_tpu.rl.ddpg import init_ddpg_nets
+        from ray_tpu.rl.td3 import _TD3Worker
+
+        obs_dim, _n, act_dim, act_high = probe_env_spec(
+            cfg.env, cfg.env_config)
+        assert act_dim is not None, "APEX-DDPG is continuous-action"
+        self.act_high = act_high or 1.0
+        self.nets = init_ddpg_nets(jax.random.PRNGKey(cfg.seed), obs_dim,
+                                   act_dim, cfg.hidden)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.nets)
+        self.actor_opt = optax.adam(cfg.actor_lr)
+        self.critic_opt = optax.adam(cfg.critic_lr)
+        self.actor_os = self.actor_opt.init(self.nets["actor"])
+        self.critic_os = self.critic_opt.init(self.nets["q"])
+        self.shards = [
+            PrioritizedReplayActor.options(num_cpus=0.2).remote(
+                cfg.replay_capacity // cfg.num_replay_shards,
+                cfg.prioritized_alpha, cfg.seed + s)
+            for s in range(cfg.num_replay_shards)]
+        self.workers = [
+            _TD3Worker.options(num_cpus=0.4).remote(
+                cfg.env, cfg.seed + i * 1000, cfg.env_config)
+            for i in range(cfg.num_rollout_workers)]
+        n = max(1, cfg.num_rollout_workers - 1)
+        self._noise = [cfg.noise_base ** (1 + 2 * i / n)
+                       for i in range(cfg.num_rollout_workers)]
+        self._inflight: Dict[Any, int] = {}
+        self.timesteps = 0
+        self.num_updates = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rl.td3 import policy_action, q_value
+
+        cfg = self.config
+        act_high = self.act_high
+
+        def update(nets, target, actor_os, critic_os, mb):
+            def critic_loss(q):
+                a_next = policy_action(target["actor"], mb["next_obs"],
+                                       act_high)
+                tq = q_value(target["q"], mb["next_obs"], a_next)
+                backup = jax.lax.stop_gradient(
+                    mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * tq)
+                td = q_value(q, mb["obs"], mb["actions"]) - backup
+                return (mb["_weights"] * jnp.square(td)).mean(), jnp.abs(td)
+
+            (closs, td), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True)(nets["q"])
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os,
+                                                     nets["q"])
+            nets = {**nets, "q": optax.apply_updates(nets["q"], cupd)}
+
+            def actor_loss(actor):
+                a = policy_action(actor, mb["obs"], act_high)
+                return -q_value(nets["q"], mb["obs"], a).mean()
+
+            aloss, agrads = jax.value_and_grad(actor_loss)(nets["actor"])
+            aupd, actor_os = self.actor_opt.update(agrads, actor_os,
+                                                   nets["actor"])
+            nets = {**nets,
+                    "actor": optax.apply_updates(nets["actor"], aupd)}
+            target_new = jax.tree_util.tree_map(
+                lambda t, s: (1 - cfg.tau) * t + cfg.tau * s, target, nets)
+            return nets, target_new, actor_os, critic_os, closs, td
+
+        return update
+
+    def _launch(self, i: int, actor_host):
+        ref = self.workers[i].sample.remote(
+            actor_host, self.config.rollout_fragment_length,
+            self.timesteps < self.config.learning_starts, self._noise[i])
+        self._inflight[ref] = i
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        actor_host = jax.device_get(self.nets["actor"])
+        for i in range(len(self.workers)):
+            if i not in self._inflight.values():
+                self._launch(i, actor_host)
+
+        ready, _ = ray_tpu.wait(list(self._inflight),
+                                num_returns=len(self._inflight), timeout=0.2)
+        for ref in ready:
+            i = self._inflight.pop(ref)
+            b = ray_tpu.get(ref)
+            self.timesteps += len(b["rewards"])
+            self.shards[i % len(self.shards)].add_batch.remote(b)
+            self._launch(i, actor_host)
+
+        loss = float("nan")
+        updates = 0
+        sizes = ray_tpu.get([s.size.remote() for s in self.shards])
+        if sum(sizes) >= cfg.learning_starts:
+            for u in range(cfg.updates_per_iter):
+                shard = self.shards[u % len(self.shards)]
+                mb = ray_tpu.get(shard.sample.remote(
+                    cfg.train_batch_size, cfg.prioritized_beta))
+                if mb is None:
+                    continue
+                indices = mb.pop("_indices")
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                (self.nets, self.target, self.actor_os, self.critic_os,
+                 loss, td) = self._update(self.nets, self.target,
+                                          self.actor_os, self.critic_os, mb)
+                shard.update_priorities.remote(indices, np.asarray(td))
+                updates += 1
+                self.num_updates += 1
+            loss = float(loss)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        return {
+            **rollout_result(self.timesteps, stats, {}),
+            "num_updates": self.num_updates,
+            "updates_this_iter": updates,
+            "replay_size": sum(sizes),
+            "critic_loss": loss,
+        }
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
         self.target = jax.tree_util.tree_map(lambda x: x, weights)
 
     def stop(self):

@@ -1,0 +1,349 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Reference: rllib/algorithms/maddpg/ (maddpg.py — "Multi-Agent
+Actor-Critic for Mixed Cooperative-Competitive Environments", Lowe et
+al.: each agent has a decentralized deterministic actor pi_i(o_i) and a
+CENTRALIZED critic Q_i(o_1..o_n, a_1..a_n) that sees every agent's
+observation and action during training; execution uses only the local
+actor). The reference runs on MPE particle envs; the built-in
+LineSpreadEnv below is a 1-D cooperative-spread equivalent.
+
+Continuous multi-agent envs extend the MultiAgentEnv protocol with
+`act_dims: Dict[str, int]` (actions in [-1, 1]^d)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import (Algorithm, ReplayBuffer, episode_stats_from,
+                             mlp_forward, mlp_init)
+from ray_tpu.rl.multi_agent import (MultiAgentEnv, make_multi_agent_env,
+                                    register_multi_agent_env)
+
+
+class LineSpreadEnv(MultiAgentEnv):
+    """Cooperative spread on a line: two agents move on [-2, 2]; two
+    fixed targets; team reward is -sum over targets of the distance to
+    the closest agent (maximised by the agents splitting up, one per
+    target — the credit-assignment structure MPE simple_spread tests)."""
+
+    def __init__(self, episode_len: int = 25, seed: int = 0):
+        self.possible_agents = ["a", "b"]
+        # obs: [own_pos, other_pos, target0, target1]
+        self.obs_dims = {aid: 4 for aid in self.possible_agents}
+        self.n_actions = {}                  # continuous env
+        self.act_dims = {aid: 1 for aid in self.possible_agents}
+        self.episode_len = episode_len
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+
+    def _obs(self):
+        out = {}
+        for i, aid in enumerate(self.possible_agents):
+            other = self.pos[1 - i]
+            out[aid] = np.asarray(
+                [self.pos[i], other, self.targets[0], self.targets[1]],
+                np.float32)
+        return out
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self.pos = self._rng.uniform(-1, 1, 2)
+        self.targets = self._rng.uniform(-1.5, 1.5, 2)
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        self._t += 1
+        for i, aid in enumerate(self.possible_agents):
+            v = float(np.clip(np.asarray(action_dict[aid]).reshape(-1)[0],
+                              -1, 1))
+            self.pos[i] = float(np.clip(self.pos[i] + 0.25 * v, -2, 2))
+        cover = sum(min(abs(t - p) for p in self.pos)
+                    for t in self.targets)
+        rew = -float(cover)
+        done = self._t >= self.episode_len
+        half = rew / 2.0
+        rews = {aid: half for aid in self.possible_agents}
+        term = {aid: done for aid in self.possible_agents}
+        term["__all__"] = done
+        trunc = {aid: False for aid in self.possible_agents}
+        trunc["__all__"] = False
+        return self._obs(), rews, term, trunc, {}
+
+
+register_multi_agent_env("line_spread", LineSpreadEnv)
+
+
+# --- networks ----------------------------------------------------------------
+
+
+def init_maddpg_nets(key, n_agents: int, obs_dims: List[int],
+                     act_dims: List[int], hidden: int):
+    import jax
+
+    joint = sum(obs_dims) + sum(act_dims)
+    nets = {"actors": [], "critics": []}
+    ks = jax.random.split(key, 2 * n_agents)
+    for i in range(n_agents):
+        nets["actors"].append(mlp_init(
+            ks[2 * i], [obs_dims[i], hidden, hidden, act_dims[i]],
+            out_scale=0.01))
+        nets["critics"].append(mlp_init(
+            ks[2 * i + 1], [joint, hidden, hidden, 1]))
+    return nets
+
+
+def actor_action(actor, obs):
+    import jax.numpy as jnp
+
+    return jnp.tanh(mlp_forward(actor, obs))
+
+
+def critic_value(critic, joint_obs, joint_act):
+    import jax.numpy as jnp
+
+    return mlp_forward(critic,
+                       jnp.concatenate([joint_obs, joint_act], -1))[..., 0]
+
+
+# --- rollout worker ----------------------------------------------------------
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _MADDPGWorker:
+    def __init__(self, env_name, env_config: dict, seed: int):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.env = make_multi_agent_env(env_name, env_config or {})
+        self.agents = list(self.env.possible_agents)
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+        self.completed: List[float] = []
+
+    def sample(self, actors, num_steps: int, noise: float,
+               random_actions: bool):
+        import jax.numpy as jnp
+
+        cols = {k: [] for k in ("obs", "actions", "rewards", "dones",
+                                "next_obs")}
+        for _ in range(num_steps):
+            acts, flat = {}, []
+            for i, aid in enumerate(self.agents):
+                d = self.env.act_dims[aid]
+                if random_actions:
+                    a = self.rng.uniform(-1, 1, d).astype(np.float32)
+                else:
+                    a = np.asarray(actor_action(
+                        actors[i],
+                        jnp.asarray(self.obs[aid], jnp.float32)[None]))[0]
+                    a = np.clip(a + self.rng.normal(0, noise, d),
+                                -1, 1).astype(np.float32)
+                acts[aid] = a
+                flat.append(a)
+            so = np.concatenate([np.asarray(self.obs[a], np.float32)
+                                 for a in self.agents])
+            nobs, rew, term, trunc, _ = self.env.step(acts)
+            done = term.get("__all__", False) or trunc.get("__all__", False)
+            cols["obs"].append(so)
+            cols["actions"].append(np.concatenate(flat))
+            cols["rewards"].append(float(sum(rew.values())))
+            cols["dones"].append(float(done))
+            self.episode_return += float(sum(rew.values()))
+            if done:
+                self.completed.append(self.episode_return)
+                self.episode_return = 0.0
+                nobs, _ = self.env.reset()
+            self.obs = nobs
+            cols["next_obs"].append(
+                np.concatenate([np.asarray(nobs[a], np.float32)
+                                for a in self.agents]))
+        return {k: np.stack(v).astype(np.float32) for k, v in cols.items()}
+
+    def episode_stats(self):
+        return episode_stats_from(self.completed)
+
+
+# --- trainer -----------------------------------------------------------------
+
+
+@dataclass
+class MADDPGConfig:
+    env: Any = "line_spread"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 50
+    replay_capacity: int = 50_000
+    learning_starts: int = 300
+    train_batch_size: int = 128
+    updates_per_iter: int = 16
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    gamma: float = 0.95
+    tau: float = 0.01
+    exploration_noise: float = 0.2
+    hidden: int = 64
+    seed: int = 0
+
+
+class MADDPGTrainer(Algorithm):
+    """ref: rllib/algorithms/maddpg/maddpg.py training_step — joint
+    replay, per-agent centralized-critic TD + decentralized actor
+    ascent, polyak targets."""
+
+    def _setup(self, cfg: MADDPGConfig):
+        import jax
+        import optax
+
+        probe = make_multi_agent_env(cfg.env, cfg.env_config)
+        self.agents = list(probe.possible_agents)
+        self.obs_dims = [probe.obs_dims[a] for a in self.agents]
+        self.act_dims = [probe.act_dims[a] for a in self.agents]
+        self.nets = init_maddpg_nets(jax.random.PRNGKey(cfg.seed),
+                                     len(self.agents), self.obs_dims,
+                                     self.act_dims, cfg.hidden)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.nets)
+        self.opt = optax.adam(cfg.actor_lr)
+        self.copt = optax.adam(cfg.critic_lr)
+        self.actor_os = [self.opt.init(a) for a in self.nets["actors"]]
+        self.critic_os = [self.copt.init(c) for c in self.nets["critics"]]
+        self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
+        self.workers = [
+            _MADDPGWorker.remote(cfg.env, cfg.env_config,
+                                 cfg.seed + i * 1000)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self._update = jax.jit(self._make_update())
+
+    def _split_obs(self, joint):
+        import jax.numpy as jnp
+
+        outs, off = [], 0
+        for d in self.obs_dims:
+            outs.append(joint[:, off:off + d])
+            off += d
+        return outs
+
+    def _split_act(self, joint):
+        outs, off = [], 0
+        for d in self.act_dims:
+            outs.append(joint[:, off:off + d])
+            off += d
+        return outs
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        n = len(self.agents)
+
+        def update(nets, target, actor_os, critic_os, mb):
+            obs_i = self._split_obs(mb["obs"])
+            nobs_i = self._split_obs(mb["next_obs"])
+            act_i = self._split_act(mb["actions"])
+            # target joint next action from all target actors
+            a_next = jnp.concatenate(
+                [actor_action(target["actors"][i], nobs_i[i])
+                 for i in range(n)], -1)
+            closs_sum = aloss_sum = 0.0
+            new_actors, new_critics = [], []
+            new_aos, new_cos = [], []
+            for i in range(n):
+                def critic_loss(c):
+                    tq = critic_value(target["critics"][i],
+                                      mb["next_obs"], a_next)
+                    backup = jax.lax.stop_gradient(
+                        mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * tq)
+                    return jnp.square(
+                        critic_value(c, mb["obs"], mb["actions"])
+                        - backup).mean()
+
+                closs, cg = jax.value_and_grad(critic_loss)(
+                    nets["critics"][i])
+                cu, cos = self.copt.update(cg, critic_os[i],
+                                           nets["critics"][i])
+                critic_i = optax.apply_updates(nets["critics"][i], cu)
+
+                def actor_loss(a):
+                    acts = [actor_action(a, obs_i[j]) if j == i
+                            else jax.lax.stop_gradient(act_i[j])
+                            for j in range(n)]
+                    return -critic_value(critic_i, mb["obs"],
+                                         jnp.concatenate(acts, -1)).mean()
+
+                aloss, ag = jax.value_and_grad(actor_loss)(
+                    nets["actors"][i])
+                au, aos = self.opt.update(ag, actor_os[i],
+                                          nets["actors"][i])
+                new_actors.append(
+                    optax.apply_updates(nets["actors"][i], au))
+                new_critics.append(critic_i)
+                new_aos.append(aos)
+                new_cos.append(cos)
+                closs_sum += closs
+                aloss_sum += aloss
+            nets = {"actors": new_actors, "critics": new_critics}
+            target = jax.tree_util.tree_map(
+                lambda t, s: (1 - cfg.tau) * t + cfg.tau * s, target, nets)
+            return (nets, target, new_aos, new_cos,
+                    closs_sum / n, aloss_sum / n)
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        actors_host = jax.device_get(self.nets["actors"])
+        refs = [w.sample.remote(actors_host, cfg.rollout_fragment_length,
+                                cfg.exploration_noise,
+                                self.timesteps < cfg.learning_starts)
+                for w in self.workers]
+        for b in ray_tpu.get(refs):
+            self.buffer.add_batch(b)
+            self.timesteps += len(b["rewards"])
+
+        closs = aloss = float("nan")
+        updates = 0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                (self.nets, self.target, self.actor_os, self.critic_os,
+                 closs, aloss) = self._update(
+                    self.nets, self.target, self.actor_os,
+                    self.critic_os, mb)
+                updates += 1
+            closs, aloss = float(closs), float(aloss)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "critic_loss": closs,
+            "actor_loss": aloss,
+            "num_updates": updates,
+            "buffer_size": len(self.buffer),
+        }
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, weights)

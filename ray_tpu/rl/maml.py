@@ -1,0 +1,269 @@
+"""MAML: model-agnostic meta-learning for RL.
+
+Reference: rllib/algorithms/maml/ (maml.py — Finn et al. 2017: sample a
+batch of TASKS; per task, collect pre-adaptation rollouts, take an inner
+policy-gradient step, collect post-adaptation rollouts; the meta-update
+differentiates the post-adaptation objective THROUGH the inner step).
+The reference wires this as a torch higher-order-grad workaround; in JAX
+the meta-gradient is literally `jax.grad` of a function containing the
+inner `jax.grad` step — the TPU-native shape of the algorithm.
+
+Task distribution: 2-D point navigation with per-task goals (the MAML
+paper's point-robot experiment; rllib uses the same via
+examples/env/pointmass env families)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import Algorithm, mlp_forward, mlp_init
+
+
+# --- task env: point navigation ---------------------------------------------
+
+
+class PointGoalEnv:
+    """Agent on the 2-D plane; action = velocity in [-1,1]^2 (scaled by
+    0.1); reward = -distance to the task's goal. The goal is the task."""
+
+    H = 20                      # horizon
+    OBS_DIM = 2
+    ACT_DIM = 2
+
+    def __init__(self, goal: np.ndarray):
+        self.goal = np.asarray(goal, np.float32)
+        self.pos = np.zeros(2, np.float32)
+        self.t = 0
+
+    def reset(self):
+        self.pos = np.zeros(2, np.float32)
+        self.t = 0
+        return self.pos.copy()
+
+    def step(self, action):
+        a = np.clip(np.asarray(action, np.float32), -1, 1)
+        self.pos = self.pos + 0.1 * a
+        self.t += 1
+        rew = -float(np.linalg.norm(self.pos - self.goal))
+        return self.pos.copy(), rew, self.t >= self.H
+
+
+def sample_goal(rng) -> np.ndarray:
+    ang = rng.uniform(0, 2 * np.pi)
+    r = rng.uniform(0.5, 1.0)
+    return np.asarray([r * np.cos(ang), r * np.sin(ang)], np.float32)
+
+
+# --- Gaussian policy ---------------------------------------------------------
+
+
+def init_maml_policy(key, hidden: int):
+    import jax.numpy as jnp
+
+    return {"net": mlp_init(key, [PointGoalEnv.OBS_DIM, hidden, hidden,
+                                  PointGoalEnv.ACT_DIM], out_scale=0.01),
+            "log_std": jnp.full((PointGoalEnv.ACT_DIM,), -0.5)}
+
+
+def policy_mean(params, obs):
+    return mlp_forward(params["net"], obs)
+
+
+def gaussian_logp(params, obs, acts):
+    import jax.numpy as jnp
+
+    mu = policy_mean(params, obs)
+    log_std = jnp.clip(params["log_std"], -3.0, 1.0)
+    return (-0.5 * jnp.square((acts - mu) / jnp.exp(log_std))
+            - log_std - 0.5 * np.log(2 * np.pi)).sum(-1)
+
+
+def pg_loss(params, batch):
+    """REINFORCE with reward-to-go advantages (the MAML paper's inner
+    objective; adv normalized per batch)."""
+    import jax.numpy as jnp
+
+    logp = gaussian_logp(params, batch["obs"], batch["actions"])
+    adv = batch["adv"]
+    return -(logp * adv).mean()
+
+
+def inner_adapt(params, batch, inner_lr: float):
+    """One differentiable inner gradient step (ref: maml.py inner
+    adaptation; jax.grad makes the higher-order case free)."""
+    import jax
+
+    grads = jax.grad(pg_loss)(params, batch)
+    return jax.tree_util.tree_map(lambda p, g: p - inner_lr * g,
+                                  params, grads)
+
+
+# --- rollout worker ----------------------------------------------------------
+
+
+def _rollout(env: PointGoalEnv, params, episodes: int, gamma: float, rng):
+    import jax.numpy as jnp
+
+    obs_l, act_l, rew_l = [], [], []
+    returns = []
+    for _ in range(episodes):
+        obs = env.reset()
+        ep_rews = []
+        for _ in range(env.H):
+            mu = np.asarray(policy_mean(params,
+                                        jnp.asarray(obs)[None]))[0]
+            std = np.exp(np.clip(np.asarray(params["log_std"]), -3, 1))
+            a = (mu + std * rng.standard_normal(env.ACT_DIM)).astype(
+                np.float32)
+            nobs, rew, done = env.step(a)
+            obs_l.append(obs)
+            act_l.append(a)
+            ep_rews.append(rew)
+            obs = nobs
+        # reward-to-go within the episode
+        rtg = np.asarray(ep_rews, np.float32)
+        for t in range(len(rtg) - 2, -1, -1):
+            rtg[t] += gamma * rtg[t + 1]
+        rew_l.append(rtg)
+        returns.append(float(np.sum(ep_rews)))
+    adv = np.concatenate(rew_l)
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    return {"obs": np.stack(obs_l).astype(np.float32),
+            "actions": np.stack(act_l),
+            "adv": adv.astype(np.float32)}, float(np.mean(returns))
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _MAMLWorker:
+    """One task per call: sample a goal, collect pre-adaptation data,
+    adapt locally (numerically), collect post-adaptation data. The
+    driver re-plays the adaptation SYMBOLICALLY inside the meta-loss."""
+
+    def __init__(self, seed: int, inner_lr: float, gamma: float,
+                 episodes_per_task: int):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.rng = np.random.default_rng(seed)
+        self.inner_lr = inner_lr
+        self.gamma = gamma
+        self.episodes = episodes_per_task
+
+    def sample_task(self, params) -> Tuple[dict, dict, float, float]:
+        import jax
+
+        env = PointGoalEnv(sample_goal(self.rng))
+        pre, ret_pre = _rollout(env, params, self.episodes, self.gamma,
+                                self.rng)
+        adapted = inner_adapt(params,
+                              {k: jax.numpy.asarray(v)
+                               for k, v in pre.items()}, self.inner_lr)
+        post, ret_post = _rollout(env, adapted, self.episodes, self.gamma,
+                                  self.rng)
+        return pre, post, ret_pre, ret_post
+
+
+# --- trainer -----------------------------------------------------------------
+
+
+@dataclass
+class MAMLConfig:
+    num_rollout_workers: int = 2     # == tasks per meta-batch
+    episodes_per_task: int = 4
+    inner_lr: float = 0.1
+    meta_lr: float = 1e-3
+    gamma: float = 0.99
+    hidden: int = 32
+    seed: int = 0
+
+
+class MAMLTrainer(Algorithm):
+    """ref: maml.py training_step — fan tasks out, meta-gradient of the
+    post-adaptation loss through the inner step, averaged over tasks."""
+
+    def _setup(self, cfg: MAMLConfig):
+        import jax
+        import optax
+
+        self.params = init_maml_policy(jax.random.PRNGKey(cfg.seed),
+                                       cfg.hidden)
+        self.opt = optax.adam(cfg.meta_lr)
+        self.opt_state = self.opt.init(self.params)
+        self.workers = [
+            _MAMLWorker.remote(cfg.seed + i * 1000, cfg.inner_lr,
+                               cfg.gamma, cfg.episodes_per_task)
+            for i in range(cfg.num_rollout_workers)]
+        self.tasks_total = 0
+        self._meta_update = jax.jit(self._make_meta_update())
+
+    def _make_meta_update(self):
+        import jax
+        import optax
+
+        inner_lr = self.config.inner_lr
+
+        def meta_loss_one(params, pre, post):
+            adapted = inner_adapt(params, pre, inner_lr)
+            return pg_loss(adapted, post)
+
+        def meta_update(params, opt_state, pres, posts):
+            def total(p):
+                losses = [meta_loss_one(p, pre, post)
+                          for pre, post in zip(pres, posts)]
+                return sum(losses) / len(losses)
+
+            loss, grads = jax.value_and_grad(total)(params)
+            upd, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, upd), opt_state, loss
+
+        return meta_update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        params_host = jax.device_get(self.params)
+        results = ray_tpu.get([w.sample_task.remote(params_host)
+                               for w in self.workers])
+        pres = [{k: jnp.asarray(v) for k, v in pre.items()}
+                for pre, _, _, _ in results]
+        posts = [{k: jnp.asarray(v) for k, v in post.items()}
+                 for _, post, _, _ in results]
+        self.params, self.opt_state, loss = self._meta_update(
+            self.params, self.opt_state, pres, posts)
+        self.tasks_total += len(results)
+        return {
+            "tasks_total": self.tasks_total,
+            "meta_loss": float(loss),
+            "pre_adapt_return_mean": float(np.mean(
+                [r[2] for r in results])),
+            "post_adapt_return_mean": float(np.mean(
+                [r[3] for r in results])),
+        }
+
+    def adapt(self, goal, episodes: int = 4) -> Tuple[dict, float, float]:
+        """Adapt to a NEW task with one inner step; returns (adapted
+        params, pre-return, post-return) — the deployment-time API."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed + 999)
+        env = PointGoalEnv(np.asarray(goal, np.float32))
+        params_host = jax.device_get(self.params)
+        pre, ret_pre = _rollout(env, params_host, episodes, cfg.gamma, rng)
+        adapted = inner_adapt(params_host,
+                              {k: jnp.asarray(v) for k, v in pre.items()},
+                              cfg.inner_lr)
+        _, ret_post = _rollout(env, adapted, episodes, cfg.gamma, rng)
+        return adapted, ret_pre, ret_post
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights):
+        self.params = weights

@@ -298,3 +298,128 @@ class CQLTrainer(_OfflineMixin, Algorithm):
         self.nets = weights
         self.target_q = jax.tree_util.tree_map(
             lambda x: x, {"q1": self.nets["q1"], "q2": self.nets["q2"]})
+
+
+@dataclass
+class CRRConfig:
+    dataset: Any = None  # {"obs","actions","rewards","dones","next_obs"}
+    n_actions: int = 0               # inferred from data when 0
+    lr: float = 1e-3
+    gamma: float = 0.99
+    train_batch_size: int = 256
+    updates_per_iter: int = 32
+    target_update_freq: int = 8      # in updates
+    # "binary" (indicator on positive advantage) or "exp" (exp(A/beta))
+    weight_mode: str = "binary"
+    beta: float = 1.0
+    weight_clip: float = 20.0
+    hidden: int = 128
+    seed: int = 0
+
+
+class CRRTrainer(_OfflineMixin, Algorithm):
+    """CRR: critic-regularized regression (ref: rllib/algorithms/crr/ —
+    offline actor-critic where the policy does filtered/weighted
+    behavior cloning: only actions the critic scores above the policy's
+    own expected value get cloned; the critic trains with expected-SARSA
+    TD under the current policy)."""
+
+    def _setup(self, cfg: CRRConfig):
+        import jax
+        import optax
+
+        assert cfg.dataset is not None, "CRR needs an offline dataset"
+        self._init_data(cfg.dataset, cfg.train_batch_size, cfg.seed)
+        obs_dim = int(self.data["obs"].shape[-1])
+        n_actions = cfg.n_actions or int(self.data["actions"].max()) + 1
+        k1, k2 = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.nets = {
+            "actor": mlp_init(k1, [obs_dim, cfg.hidden, cfg.hidden,
+                                   n_actions], out_scale=0.01),
+            "q": mlp_init(k2, [obs_dim, cfg.hidden, cfg.hidden,
+                               n_actions], out_scale=0.01),
+        }
+        self.target_q = jax.tree_util.tree_map(lambda x: x, self.nets["q"])
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.nets)
+        self.workers = []
+        self._n_updates = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(nets, target_q, mb):
+            acts = mb["actions"][:, None].astype(jnp.int32)
+            # critic: expected-SARSA backup under the current policy
+            pi_next = jax.nn.softmax(
+                mlp_forward(nets["actor"], mb["next_obs"]))
+            v_next = (jax.lax.stop_gradient(pi_next)
+                      * mlp_forward(target_q, mb["next_obs"])).sum(-1)
+            backup = jax.lax.stop_gradient(
+                mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * v_next)
+            q_all = mlp_forward(nets["q"], mb["obs"])
+            q_sel = jnp.take_along_axis(q_all, acts, -1)[:, 0]
+            critic_loss = jnp.square(q_sel - backup).mean()
+            # actor: advantage-filtered behavior cloning
+            logits = mlp_forward(nets["actor"], mb["obs"])
+            logp = jnp.take_along_axis(jax.nn.log_softmax(logits),
+                                       acts, -1)[:, 0]
+            pi = jax.nn.softmax(logits)
+            v = (jax.lax.stop_gradient(pi) * q_all).sum(-1)
+            adv = jax.lax.stop_gradient(q_sel - v)
+            if cfg.weight_mode == "binary":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / cfg.beta), cfg.weight_clip)
+            actor_loss = -(w * logp).mean()
+            total = actor_loss + critic_loss
+            return total, {"actor_loss": actor_loss,
+                           "critic_loss": critic_loss,
+                           "mean_weight": w.mean(),
+                           "mean_advantage": adv.mean()}
+
+        def update(nets, target_q, opt_state, mb):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(nets, target_q, mb)
+            upd, opt_state = self.opt.update(grads, opt_state, nets)
+            return optax.apply_updates(nets, upd), opt_state, \
+                {"loss": loss, **aux}
+
+        return update
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        aux = {}
+        for _ in range(self.config.updates_per_iter):
+            self.nets, self.opt_state, aux = self._update(
+                self.nets, self.target_q, self.opt_state,
+                self._minibatch())
+            self._n_updates += 1
+            if self._n_updates % self.config.target_update_freq == 0:
+                self.target_q = jax.tree_util.tree_map(
+                    lambda x: x, self.nets["q"])
+        return {"num_samples": self.n,
+                **{k: float(v) for k, v in aux.items()}}
+
+    def compute_action(self, obs):
+        import jax.numpy as jnp
+
+        logits = np.asarray(
+            mlp_forward(self.nets["actor"], jnp.asarray(obs)[None]))[0]
+        return int(logits.argmax())
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
+        self.target_q = jax.tree_util.tree_map(lambda x: x,
+                                               self.nets["q"])

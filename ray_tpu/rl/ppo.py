@@ -146,13 +146,11 @@ def compute_gae(batch: dict, gamma: float, lam: float):
     return adv, returns
 
 
-def make_ppo_update(cfg, opt):
-    """Build the (un-jitted) clipped-surrogate update shared by
-    PPOTrainer and MultiAgentPPOTrainer. cfg needs .clip/.vf_coeff/
-    .entropy_coeff; opt is an optax optimizer."""
+def make_ppo_loss(clip: float, vf_coeff: float, entropy_coeff: float):
+    """The clipped-surrogate loss alone (shared by make_ppo_update and
+    the DDPPO worker-side gradient, ddppo.py)."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     def loss_fn(params, mb):
         logits, value = policy_forward(params, mb["obs"])
@@ -163,11 +161,23 @@ def make_ppo_update(cfg, opt):
         adv = mb["adv"]
         pg = -jnp.minimum(
             ratio * adv,
-            jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv).mean()
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
         vf = 0.5 * jnp.square(value - mb["returns"]).mean()
         ent = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-        total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+        total = pg + vf_coeff * vf - entropy_coeff * ent
         return total, {"pg_loss": pg, "vf_loss": vf, "entropy": ent}
+
+    return loss_fn
+
+
+def make_ppo_update(cfg, opt):
+    """Build the (un-jitted) clipped-surrogate update shared by
+    PPOTrainer and MultiAgentPPOTrainer. cfg needs .clip/.vf_coeff/
+    .entropy_coeff; opt is an optax optimizer."""
+    import jax
+    import optax
+
+    loss_fn = make_ppo_loss(cfg.clip, cfg.vf_coeff, cfg.entropy_coeff)
 
     def update(params, opt_state, mb):
         (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(

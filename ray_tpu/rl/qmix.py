@@ -1,0 +1,322 @@
+"""QMIX: monotonic value-function factorisation for cooperative MARL.
+
+Reference: rllib/algorithms/qmix/ (qmix.py, qmix_policy.py — per-agent
+utility networks + a mixing network whose non-negative weights are
+emitted by state-conditioned hypernetworks, trained end-to-end with TD
+on the mixed Q_tot; Rashid et al. 2018) and rllib's TwoStepGame example
+(rllib/examples/two_step_game.py), reproduced here as the built-in
+cooperative env. Simplification vs the reference: feed-forward agent
+networks (the reference defaults to RNN agents) — the factorisation,
+hypernetwork mixer and double-Q target path are the algorithm.
+
+The global state for mixing is the concatenation of all agent
+observations (rllib's default when the env exposes no state)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import (Algorithm, ReplayBuffer, episode_stats_from,
+                             mlp_forward, mlp_init, probe_env_spec)
+from ray_tpu.rl.multi_agent import (MultiAgentEnv, make_multi_agent_env,
+                                    register_multi_agent_env)
+
+
+class TwoStepGame(MultiAgentEnv):
+    """The QMIX paper's coordination test (ref:
+    rllib/examples/two_step_game.py): agent a's first action selects
+    matrix game 2A (payoff 7 regardless) or 2B (payoff 8 only if both
+    agents then pick action 1, else 0/1). Greedy independent learners
+    settle for 7; a correctly mixed joint value discovers 8."""
+
+    def __init__(self, seed: int = 0):
+        self.possible_agents = ["a", "b"]
+        self.obs_dims = {aid: 3 for aid in self.possible_agents}
+        self.n_actions = {aid: 2 for aid in self.possible_agents}
+        self._stage = 0
+
+    def _obs(self):
+        o = np.zeros(3, np.float32)
+        o[self._stage] = 1.0
+        return {aid: o.copy() for aid in self.possible_agents}
+
+    def reset(self, seed: Optional[int] = None):
+        self._stage = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        done = False
+        rew = 0.0
+        if self._stage == 0:
+            # agent a picks the matrix: 0 -> game 2A, 1 -> game 2B
+            self._stage = 1 if action_dict["a"] == 0 else 2
+        else:
+            done = True
+            if self._stage == 1:
+                rew = 7.0
+            else:
+                both = action_dict["a"] == 1 and action_dict["b"] == 1
+                none = action_dict["a"] == 0 and action_dict["b"] == 0
+                rew = 8.0 if both else (1.0 if none else 0.0)
+        obs = self._obs()
+        half = rew / 2.0   # team reward split evenly (rllib example)
+        rews = {aid: half for aid in self.possible_agents}
+        term = {aid: done for aid in self.possible_agents}
+        term["__all__"] = done
+        trunc = {aid: False for aid in self.possible_agents}
+        trunc["__all__"] = False
+        return obs, rews, term, trunc, {}
+
+
+register_multi_agent_env("two_step_game", TwoStepGame)
+
+
+# --- networks ----------------------------------------------------------------
+
+
+def init_qmix_nets(key, n_agents: int, obs_dim: int, n_actions: int,
+                   state_dim: int, hidden: int, embed: int):
+    import jax
+
+    ks = jax.random.split(key, 5)
+    return {
+        # one utility net shared across agents (parameter sharing, the
+        # rllib default); agents are distinguished by their observations
+        "agent": mlp_init(ks[0], [obs_dim, hidden, n_actions],
+                          out_scale=0.01),
+        "hyper_w1": mlp_init(ks[1], [state_dim, hidden, n_agents * embed]),
+        "hyper_b1": mlp_init(ks[2], [state_dim, embed]),
+        "hyper_w2": mlp_init(ks[3], [state_dim, hidden, embed]),
+        "hyper_b2": mlp_init(ks[4], [state_dim, hidden, 1]),
+    }
+
+
+def agent_qs(nets, obs):
+    """Per-agent utilities; obs [B, n_agents, obs_dim] -> [B, n_agents, A]."""
+    return mlp_forward(nets["agent"], obs)
+
+
+def mix(nets, qs, state):
+    """Monotonic mixer: Q_tot from per-agent chosen Qs [B, n_agents] and
+    global state [B, S]. Non-negativity of the mixing weights (abs on the
+    hypernet outputs) is what guarantees dQ_tot/dq_i >= 0."""
+    import jax.numpy as jnp
+
+    B, n = qs.shape
+    w1 = jnp.abs(mlp_forward(nets["hyper_w1"], state)).reshape(B, n, -1)
+    b1 = mlp_forward(nets["hyper_b1"], state)
+    hidden = jnp.einsum("bn,bne->be", qs, w1) + b1
+    hidden = jnp.where(hidden > 0, hidden, jnp.expm1(hidden))  # ELU
+    w2 = jnp.abs(mlp_forward(nets["hyper_w2"], state))
+    b2 = mlp_forward(nets["hyper_b2"], state)[:, 0]
+    return (hidden * w2).sum(-1) + b2
+
+
+# --- rollout worker ----------------------------------------------------------
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _QMIXWorker:
+    """Epsilon-greedy sampler over a dict env, emitting joint transitions
+    {obs [T,n,O], state [T,S], actions [T,n], reward, done, next_*}."""
+
+    def __init__(self, env_name, env_config: dict, seed: int):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.env = make_multi_agent_env(env_name, env_config or {})
+        self.agents = list(self.env.possible_agents)
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+        self.completed: List[float] = []
+
+    def _stack(self, obs_dict):
+        return np.stack([np.asarray(obs_dict[a], np.float32)
+                         for a in self.agents])
+
+    def sample(self, nets, num_steps: int, epsilon: float):
+        import jax.numpy as jnp
+
+        cols = {k: [] for k in ("obs", "state", "actions", "rewards",
+                                "dones", "next_obs", "next_state")}
+        for _ in range(num_steps):
+            so = self._stack(self.obs)                    # [n, O]
+            q = np.asarray(agent_qs(nets, jnp.asarray(so)[None]))[0]
+            acts = {}
+            for i, aid in enumerate(self.agents):
+                if self.rng.random() < epsilon:
+                    acts[aid] = int(self.rng.integers(
+                        self.env.n_actions[aid]))
+                else:
+                    acts[aid] = int(q[i].argmax())
+            nobs, rew, term, trunc, _ = self.env.step(acts)
+            done = term.get("__all__", False) or trunc.get("__all__", False)
+            sn = self._stack(nobs)
+            cols["obs"].append(so)
+            cols["state"].append(so.reshape(-1))
+            cols["actions"].append(
+                np.asarray([acts[a] for a in self.agents], np.int32))
+            cols["rewards"].append(float(sum(rew.values())))
+            cols["dones"].append(float(done))
+            cols["next_obs"].append(sn)
+            cols["next_state"].append(sn.reshape(-1))
+            self.episode_return += float(sum(rew.values()))
+            if done:
+                self.completed.append(self.episode_return)
+                self.episode_return = 0.0
+                nobs, _ = self.env.reset()
+            self.obs = nobs
+        return {k: np.stack(v).astype(np.float32)
+                if k not in ("actions", "obs", "next_obs")
+                else np.stack(v) for k, v in cols.items()}
+
+    def episode_stats(self):
+        return episode_stats_from(self.completed)
+
+
+# --- trainer -----------------------------------------------------------------
+
+
+@dataclass
+class QMIXConfig:
+    env: Any = "two_step_game"
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 32
+    replay_capacity: int = 5_000
+    learning_starts: int = 64
+    train_batch_size: int = 32
+    updates_per_iter: int = 16
+    lr: float = 5e-3
+    gamma: float = 0.99
+    double_q: bool = True
+    target_network_update_freq: int = 200  # in sampled env steps
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_timesteps: int = 3_000
+    hidden: int = 32
+    mixing_embed: int = 16
+    seed: int = 0
+
+
+class QMIXTrainer(Algorithm):
+    """ref: rllib/algorithms/qmix/qmix.py training_step — sample joint
+    transitions, TD-train the factored Q_tot, periodic target sync."""
+
+    def _setup(self, cfg: QMIXConfig):
+        import jax
+        import optax
+
+        probe = make_multi_agent_env(cfg.env, cfg.env_config)
+        self.agents = list(probe.possible_agents)
+        n = len(self.agents)
+        obs_dim = probe.obs_dims[self.agents[0]]
+        n_actions = probe.n_actions[self.agents[0]]
+        assert all(probe.obs_dims[a] == obs_dim and
+                   probe.n_actions[a] == n_actions for a in self.agents), \
+            "QMIX parameter sharing needs homogeneous agent spaces"
+        self.nets = init_qmix_nets(jax.random.PRNGKey(cfg.seed), n,
+                                   obs_dim, n_actions, n * obs_dim,
+                                   cfg.hidden, cfg.mixing_embed)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.nets)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.nets)
+        self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
+        self.workers = [
+            _QMIXWorker.remote(cfg.env, cfg.env_config,
+                               cfg.seed + i * 1000)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self._since_target_sync = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+
+        def loss_fn(nets, target, mb):
+            q = agent_qs(nets, mb["obs"])                    # [B, n, A]
+            q_sel = jnp.take_along_axis(
+                q, mb["actions"][..., None], -1)[..., 0]     # [B, n]
+            q_tot = mix(nets, q_sel, mb["state"])
+            qt_next = agent_qs(target, mb["next_obs"])
+            if cfg.double_q:
+                a_star = agent_qs(nets, mb["next_obs"]).argmax(-1)
+            else:
+                a_star = qt_next.argmax(-1)
+            qn_sel = jnp.take_along_axis(
+                qt_next, a_star[..., None], -1)[..., 0]
+            q_tot_next = mix(target, qn_sel, mb["next_state"])
+            tgt = mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * q_tot_next
+            return jnp.square(q_tot - jax.lax.stop_gradient(tgt)).mean()
+
+        def update(nets, target, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(nets, target, mb)
+            upd, opt_state = self.opt.update(grads, opt_state, nets)
+            return optax.apply_updates(nets, upd), opt_state, loss
+
+        return update
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.timesteps / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        nets_host = jax.device_get(self.nets)
+        eps = self._epsilon()
+        refs = [w.sample.remote(nets_host, cfg.rollout_fragment_length,
+                                eps)
+                for w in self.workers]
+        for b in ray_tpu.get(refs):
+            self.buffer.add_batch(b)
+            n = len(b["rewards"])
+            self.timesteps += n
+            self._since_target_sync += n
+
+        loss = float("nan")
+        updates = 0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self.nets, self.opt_state, loss = self._update(
+                    self.nets, self.target, self.opt_state, mb)
+                updates += 1
+            if self._since_target_sync >= cfg.target_network_update_freq:
+                self.target = jax.tree_util.tree_map(lambda x: x, self.nets)
+                self._since_target_sync = 0
+            loss = float(loss)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "loss": loss,
+            "num_updates": updates,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+        }
+
+    def get_weights(self):
+        return self.nets
+
+    def set_weights(self, weights):
+        import jax
+
+        self.nets = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, weights)

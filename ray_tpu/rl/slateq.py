@@ -1,0 +1,319 @@
+"""SlateQ: Q-learning for slate recommendation.
+
+Reference: rllib/algorithms/slateq/ (slateq.py, slateq_tf_policy.py —
+Ie et al. 2019: the combinatorial slate action is made tractable by
+decomposing Q(s, slate) = sum_i P(click i | s, slate) * Q(s, i) under a
+conditional-logit user choice model, so only per-ITEM Q values are
+learned; slates are built greedily from click-weighted item values).
+The reference runs on RecSim; SlateRecEnv below is a lite equivalent
+(drifting user-interest vector, conditional-logit clicks with a no-click
+option, engagement rewards)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.core import (Algorithm, ReplayBuffer, episode_stats_from,
+                             mlp_forward, mlp_init)
+
+
+class SlateRecEnv:
+    """Slate recommendation with a conditional-logit user.
+
+    Each episode: `n_docs` candidate docs with feature vectors and a
+    user-interest vector (both visible to the agent — the reference's
+    RecSim exposes doc observations and user observations the same way).
+    The agent presents a slate of `slate_size` docs; the user clicks doc
+    i with probability exp(u.f_i) / (sum_slate exp(u.f_j) + exp(b_null)),
+    yielding reward u.f_i and drifting the user toward the clicked doc.
+    """
+
+    def __init__(self, n_docs: int = 10, dim: int = 4, slate_size: int = 3,
+                 episode_len: int = 20, null_bias: float = 0.5,
+                 seed: int = 0):
+        self.n_docs = n_docs
+        self.dim = dim
+        self.slate_size = slate_size
+        self.episode_len = episode_len
+        self.null_bias = null_bias
+        self._rng = np.random.default_rng(seed)
+        self.reset()
+
+    def _obs(self):
+        return {"user": self.user.copy(), "docs": self.docs.copy()}
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.user = self._rng.normal(0, 1, self.dim).astype(np.float32)
+        self.user /= np.linalg.norm(self.user)
+        self.docs = self._rng.normal(0, 1, (self.n_docs, self.dim)) \
+            .astype(np.float32)
+        self.docs /= np.linalg.norm(self.docs, axis=1, keepdims=True)
+        self.t = 0
+        return self._obs()
+
+    def click_scores(self, slate) -> np.ndarray:
+        return np.exp(self.docs[list(slate)] @ self.user)
+
+    def step(self, slate):
+        assert len(set(slate)) == self.slate_size
+        v = self.click_scores(slate)
+        probs = np.concatenate([v, [np.exp(self.null_bias)]])
+        probs /= probs.sum()
+        choice = self._rng.choice(len(probs), p=probs)
+        self.t += 1
+        done = self.t >= self.episode_len
+        if choice == len(slate):              # no click
+            return self._obs(), 0.0, -1, done
+        doc = slate[choice]
+        rew = float(self.docs[doc] @ self.user)
+        # interest drift toward the consumed doc
+        self.user = 0.9 * self.user + 0.1 * self.docs[doc]
+        self.user /= np.linalg.norm(self.user)
+        return self._obs(), rew, int(doc), done
+
+
+# --- per-item Q network ------------------------------------------------------
+
+
+def init_slateq_net(key, dim: int, hidden: int):
+    return mlp_init(key, [2 * dim, hidden, hidden, 1])
+
+
+def item_q(net, user, docs):
+    """Q(s, i) for every candidate: user [B,D], docs [B,N,D] -> [B,N]."""
+    import jax.numpy as jnp
+
+    B, N, D = docs.shape
+    u = jnp.broadcast_to(user[:, None, :], (B, N, D))
+    return mlp_forward(net, jnp.concatenate([u, docs], -1))[..., 0]
+
+
+def greedy_slate(q: np.ndarray, scores: np.ndarray, k: int) -> List[int]:
+    """Greedy slate from click-weighted item values (ref: slateq.py
+    slate construction — exact optimization is O(N choose k); top-k of
+    v_i * Q_i is the standard greedy surrogate)."""
+    return list(np.argsort(-(scores * q))[:k])
+
+
+def slate_value(q: np.ndarray, scores: np.ndarray, slate: List[int],
+                null_bias: float) -> float:
+    """E[Q | choice model] over a slate including the no-click option."""
+    v = scores[slate]
+    denom = v.sum() + np.exp(null_bias)
+    return float((v * q[slate]).sum() / denom)
+
+
+# --- rollout worker ----------------------------------------------------------
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _SlateWorker:
+    def __init__(self, env_config: dict, seed: int):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.env = SlateRecEnv(**{**env_config, "seed": seed})
+        self.rng = np.random.default_rng(seed)
+        self.obs = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+        self.completed: List[float] = []
+
+    def sample(self, net, num_steps: int, epsilon: float):
+        import jax.numpy as jnp
+
+        k = self.env.slate_size
+        cols = {c: [] for c in ("user", "docs", "slate", "clicked",
+                                "rewards", "dones", "next_user",
+                                "next_docs")}
+        for _ in range(num_steps):
+            user, docs = self.obs["user"], self.obs["docs"]
+            if self.rng.random() < epsilon:
+                slate = list(self.rng.choice(self.env.n_docs, k,
+                                             replace=False))
+            else:
+                q = np.asarray(item_q(net, jnp.asarray(user)[None],
+                                      jnp.asarray(docs)[None]))[0]
+                scores = np.exp(docs @ user)
+                slate = greedy_slate(q, scores, k)
+            nobs, rew, clicked, done = self.env.step(slate)
+            cols["user"].append(user)
+            cols["docs"].append(docs)
+            cols["slate"].append(np.asarray(slate, np.int32))
+            cols["clicked"].append(clicked)
+            cols["rewards"].append(rew)
+            cols["dones"].append(float(done))
+            cols["next_user"].append(nobs["user"])
+            cols["next_docs"].append(nobs["docs"])
+            self.episode_return += rew
+            if done:
+                self.completed.append(self.episode_return)
+                self.episode_return = 0.0
+                nobs = self.env.reset()
+            self.obs = nobs
+        out = {c: np.stack(v) for c, v in cols.items()}
+        out["clicked"] = np.asarray(cols["clicked"], np.int32)
+        out["rewards"] = np.asarray(cols["rewards"], np.float32)
+        out["dones"] = np.asarray(cols["dones"], np.float32)
+        return out
+
+    def episode_stats(self):
+        return episode_stats_from(self.completed)
+
+
+# --- trainer -----------------------------------------------------------------
+
+
+@dataclass
+class SlateQConfig:
+    env_config: Dict[str, Any] = field(default_factory=dict)
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 40
+    replay_capacity: int = 20_000
+    learning_starts: int = 200
+    train_batch_size: int = 64
+    updates_per_iter: int = 16
+    lr: float = 1e-3
+    gamma: float = 0.95
+    target_network_update_freq: int = 400  # in sampled env steps
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_timesteps: int = 5_000
+    hidden: int = 64
+    seed: int = 0
+
+
+class SlateQTrainer(Algorithm):
+    """ref: rllib/algorithms/slateq/slateq.py training_step — clicked
+    transitions TD-train the per-item Q toward
+    r + gamma * SlateValue(s', greedy slate'); no-click transitions
+    carry no item-level gradient (the null option has no Q head), as in
+    the reference's SARSA variant."""
+
+    def _setup(self, cfg: SlateQConfig):
+        import jax
+        import optax
+
+        env = SlateRecEnv(**cfg.env_config)
+        self.dim = env.dim
+        self.slate_size = env.slate_size
+        self.null_bias = env.null_bias
+        self.net = init_slateq_net(jax.random.PRNGKey(cfg.seed), env.dim,
+                                   cfg.hidden)
+        self.target = jax.tree_util.tree_map(lambda x: x, self.net)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.net)
+        self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
+        self.workers = [
+            _SlateWorker.remote(cfg.env_config, cfg.seed + i * 1000)
+            for i in range(cfg.num_rollout_workers)]
+        self.timesteps = 0
+        self._since_target_sync = 0
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        k = self.slate_size
+        null = np.exp(self.null_bias)
+
+        def next_value(target, mb):
+            """SlateValue(s', greedy slate under the TARGET net)."""
+            q = item_q(target, mb["next_user"], mb["next_docs"])   # [B,N]
+            scores = jnp.exp(
+                jnp.einsum("bnd,bd->bn", mb["next_docs"], mb["next_user"]))
+            # greedy surrogate slate: top-k click-weighted values
+            _, idx = jax.lax.top_k(scores * q, k)
+            v = jnp.take_along_axis(scores, idx, -1)
+            qs = jnp.take_along_axis(q, idx, -1)
+            return (v * qs).sum(-1) / (v.sum(-1) + null)
+
+        def loss_fn(net, target, mb):
+            nv = next_value(target, mb)
+            tgt = mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * nv
+            q_all = item_q(net, mb["user"], mb["docs"])
+            clicked = mb["clicked"]
+            has_click = (clicked >= 0).astype(jnp.float32)
+            # no-click rows still need a valid gather index
+            safe = jnp.maximum(clicked, 0)
+            q_sel = jnp.take_along_axis(q_all, safe[:, None], -1)[:, 0]
+            td = q_sel - jax.lax.stop_gradient(tgt)
+            # only clicked items receive the item-level TD update
+            # (ref: slateq SARSA update on the clicked doc)
+            return (has_click * jnp.square(td)).sum() / \
+                jnp.maximum(has_click.sum(), 1.0)
+
+        def update(net, target, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(net, target, mb)
+            upd, opt_state = self.opt.update(grads, opt_state, net)
+            return optax.apply_updates(net, upd), opt_state, loss
+
+        return update
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.timesteps / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        net_host = jax.device_get(self.net)
+        eps = self._epsilon()
+        refs = [w.sample.remote(net_host, cfg.rollout_fragment_length, eps)
+                for w in self.workers]
+        ctr = 0
+        for b in ray_tpu.get(refs):
+            self.buffer.add_batch(b)
+            n = len(b["rewards"])
+            self.timesteps += n
+            self._since_target_sync += n
+            ctr += int((b["clicked"] >= 0).sum())
+
+        loss = float("nan")
+        updates = 0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                mb = {k2: jnp.asarray(v) for k2, v in mb.items()}
+                self.net, self.opt_state, loss = self._update(
+                    self.net, self.target, self.opt_state, mb)
+                updates += 1
+            if self._since_target_sync >= cfg.target_network_update_freq:
+                self.target = jax.tree_util.tree_map(lambda x: x, self.net)
+                self._since_target_sync = 0
+            loss = float(loss)
+
+        stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])
+        eps_done = [s for s in stats if s["episodes"]]
+        return {
+            "timesteps_total": self.timesteps,
+            "episode_return_mean": float(np.mean(
+                [s["mean_return"] for s in eps_done])) if eps_done else 0.0,
+            "episodes_total": sum(s["episodes"] for s in stats),
+            "clicks_this_iter": ctr,
+            "loss": loss,
+            "num_updates": updates,
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+        }
+
+    def get_weights(self):
+        return self.net
+
+    def set_weights(self, weights):
+        import jax
+
+        self.net = weights
+        self.target = jax.tree_util.tree_map(lambda x: x, weights)
