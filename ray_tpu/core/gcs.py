@@ -37,6 +37,8 @@ from ray_tpu.core.common import (Address, NodeInfo, ResourceSet, TaskSpec)
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.core.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
+from ray_tpu.core.scheduling_policy import (HybridPolicy, SchedNode,
+                                            SpreadPolicy, pack_bundles)
 
 logger = logging.getLogger("ray_tpu.gcs")
 
@@ -95,7 +97,11 @@ class GcsServer:
         self.task_events: deque = deque(maxlen=cfg.task_event_buffer_size)
         self.pool = ClientPool()
         self.server = RpcServer(self)
-        self._round_robin = 0
+        # pluggable node-picking policies (ref: scheduling/policy/)
+        self._hybrid_policy = HybridPolicy(
+            spread_threshold=cfg.scheduler_spread_threshold,
+            top_k_fraction=cfg.scheduler_top_k_fraction)
+        self._spread_policy = SpreadPolicy()
         self._stopping = False
         self._dirty = False
         # pluggable persistence: snapshot + append-WAL (ref:
@@ -254,46 +260,32 @@ class GcsServer:
 
     # ------------------------------------------------------------- scheduling
 
-    def _feasible_nodes(self, resources: ResourceSet,
-                        exclude: Optional[set] = None) -> List[Tuple[NodeID, NodeInfo]]:
-        out = []
-        for nid, info in self.nodes.items():
-            if not info.alive or (exclude and nid in exclude):
-                continue
-            if resources.fits_in(self.available.get(nid, ResourceSet())):
-                out.append((nid, info))
-        return out
-
     async def rpc_pick_node(self, resources: ResourceSet, strategy_kind: str = "DEFAULT",
                             exclude: Optional[list] = None) -> Optional[dict]:
         """Spillback target selection (ref: ClusterResourceScheduler::
         GetBestSchedulableNode, cluster_resource_scheduler.cc:129).
 
-        DEFAULT approximates the hybrid policy: prefer packing onto nodes with
-        utilization below the spread threshold, else least-utilized. SPREAD is
-        round-robin over feasible nodes (ref: scheduling_policy.cc spread)."""
-        exclude_set = set(exclude) if exclude else None
-        cands = self._feasible_nodes(resources, exclude_set)
-        if not cands:
+        Delegates to the standalone policy suite (scheduling_policy.py):
+        DEFAULT -> HybridPolicy (truncated critical-utilization score,
+        top-k pick), SPREAD -> round-robin over available nodes."""
+        exclude_set = set(exclude) if exclude else set()
+        snapshot = [
+            SchedNode(node_id=nid, total=info.resources_total,
+                      available=self.available.get(nid, ResourceSet()),
+                      alive=info.alive)
+            for nid, info in self.nodes.items() if nid not in exclude_set]
+        if strategy_kind == "SPREAD":
+            nid = self._spread_policy.schedule(resources, snapshot)
+        else:
+            nid = self._hybrid_policy.schedule(resources, snapshot)
+        if nid is None:
             # record unmet demand for the autoscaler
             # (ref: infeasible queue -> gcs_autoscaler_state_manager.h)
             self.unmet_demand.append({"resources": resources.quantities,
                                       "ts": time.time()})
             del self.unmet_demand[:-100]
             return None
-        if strategy_kind == "SPREAD":
-            self._round_robin += 1
-            nid, info = cands[self._round_robin % len(cands)]
-        else:
-            def utilization(nid):
-                total = self.nodes[nid].resources_total.quantities
-                avail = self.available[nid].quantities
-                cpu_t = total.get("CPU", 1.0) or 1.0
-                return 1.0 - avail.get("CPU", 0.0) / cpu_t
-            below = [c for c in cands if utilization(c[0]) < self.cfg.scheduler_spread_threshold]
-            pool = below or cands
-            nid, info = min(pool, key=lambda c: utilization(c[0]))
-        return {"node_id": nid, "addr": info.nodelet_addr}
+        return {"node_id": nid, "addr": self.nodes[nid].nodelet_addr}
 
     # ------------------------------------------------------------------ actors
 
@@ -494,39 +486,29 @@ class GcsServer:
             self._wal("pgs", pg_id, pg)
             self._mark_dirty()
             return True
-        # Phase 0: pick nodes for every unplaced bundle against a scratch view.
-        scratch = {nid: rs.copy() for nid, rs in self.available.items()
-                   if self.nodes[nid].alive}
+        # Phase 0: plan via the standalone bundle-packing policy
+        # (ref: bundle_scheduling_policy.cc), honoring bundles already
+        # placed by a previous partial attempt / node-failure replacement.
         placed_on_by_strict = set(
             b["node_id"] for b in pg["bundles"] if b["node_id"] is not None)
-        plan: List[Tuple[dict, NodeID]] = []
-        for b in unplaced:
-            req: ResourceSet = b["resources"]
-            cands = [nid for nid, avail in scratch.items() if req.fits_in(avail)]
-            if strategy == "STRICT_SPREAD":
-                used = placed_on_by_strict | {nid for _, nid in plan}
-                cands = [c for c in cands if c not in used]
-            if not cands:
-                pg["state"] = "PENDING"
-                return False
-            if strategy in ("PACK", "STRICT_PACK"):
-                used = placed_on_by_strict | {nid for _, nid in plan}
-                packed = [c for c in cands if c in used]
-                nid = (packed or cands)[0]
-            elif strategy in ("SPREAD", "STRICT_SPREAD"):
-                counts = defaultdict(int)
-                for _, n in plan:
-                    counts[n] += 1
-                nid = min(cands, key=lambda c: counts[c])
-            else:
-                nid = cands[0]
-            if strategy == "STRICT_PACK":
-                all_nodes = placed_on_by_strict | {n for _, n in plan} | {nid}
-                if len(all_nodes) > 1:
-                    pg["state"] = "PENDING"
-                    return False
-            scratch[nid].subtract(req)
-            plan.append((b, nid))
+        snapshot = [
+            SchedNode(node_id=nid, total=info.resources_total,
+                      available=self.available.get(nid, ResourceSet()),
+                      alive=info.alive)
+            for nid, info in self.nodes.items()]
+        if strategy == "STRICT_PACK" and placed_on_by_strict:
+            # the gang already lives on one node; the rest must join it
+            snapshot = [n for n in snapshot
+                        if n.node_id in placed_on_by_strict]
+        exclude = placed_on_by_strict if strategy == "STRICT_SPREAD" \
+            else None
+        assignment = pack_bundles([b["resources"] for b in unplaced],
+                                  snapshot, strategy,
+                                  exclude_nodes=exclude)
+        if assignment is None:
+            pg["state"] = "PENDING"
+            return False
+        plan: List[Tuple[dict, NodeID]] = list(zip(unplaced, assignment))
         # Phase 1: PREPARE on each nodelet.
         prepared: List[Tuple[dict, NodeID]] = []
         for b, nid in plan:
