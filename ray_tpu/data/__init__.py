@@ -17,6 +17,7 @@ from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
                                   from_items, from_numpy, from_pandas,
                                   range as range_, read_binary_files,
                                   read_csv, read_images, read_json,
+                                  read_bigquery, read_mongo,
                                   read_parquet, read_sql, read_text,
                                   read_tfrecords, read_webdataset, write_sql)
 from ray_tpu.data import aggregate, preprocessors
@@ -30,6 +31,7 @@ __all__ = [
     "Dataset", "DataIterator", "from_arrow", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv", "read_images",
     "read_json", "read_parquet", "read_sql", "read_text", "read_tfrecords",
+    "read_mongo", "read_bigquery",
     "read_webdataset", "write_sql", "aggregate",
     "preprocessors", "GroupedData",
 ]

@@ -52,6 +52,83 @@ def _block_concat(blocks: List[Block]) -> Block:
     return out
 
 
+def _arrow_to_block(table) -> Block:
+    """Arrow table -> dict-of-numpy, ZERO-COPY per column when the type
+    allows (numeric, single-chunk, no nulls — the same condition the
+    reference's Arrow block accessor exploits for plasma reads); copies
+    only the columns Arrow can't view (ref: data/_internal/arrow_block.py
+    to_numpy path)."""
+    out = {}
+    for c in table.column_names:
+        col = table[c]
+        if col.num_chunks == 1:
+            try:
+                out[c] = col.chunk(0).to_numpy(zero_copy_only=True)
+                continue
+            except Exception:
+                pass
+        out[c] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+def _to_batch_format(block: Block, fmt: Optional[str]):
+    """Present a block to a UDF in the requested format (ref:
+    map_batches/iter_batches batch_format= in python/ray/data/dataset.py
+    — "numpy"/"default" dict-of-ndarray, "pandas", "pyarrow")."""
+    if fmt in (None, "default", "numpy"):
+        return block
+    if not isinstance(block, dict):
+        block = _rows_to_block(block)
+        if not isinstance(block, dict):
+            block = {"value": np.asarray(block)}
+    if fmt == "pandas":
+        import pandas as pd
+
+        return pd.DataFrame({k: (list(v) if getattr(v, "ndim", 1) > 1
+                                 else v) for k, v in block.items()})
+    if fmt == "pyarrow":
+        import pyarrow as pa
+
+        return pa.table({k: np.asarray(v) for k, v in block.items()})
+    raise ValueError(f"unsupported batch_format {fmt!r}; "
+                     "use 'numpy', 'pandas', or 'pyarrow'")
+
+
+def _coerce_block(out) -> Block:
+    """Normalize a UDF's return (dict / list / pa.Table / pd.DataFrame)
+    back into a native block."""
+    if isinstance(out, (dict, list)):
+        return out
+    mod = type(out).__module__
+    if mod.startswith("pyarrow"):
+        return _arrow_to_block(out)
+    if mod.startswith("pandas"):
+        cols = {}
+        for c in out.columns:
+            v = out[c].to_numpy()
+            if v.dtype == object and len(v) and \
+                    isinstance(v[0], np.ndarray):
+                # 2-D column that rode through pandas as array-of-arrays
+                # (see _to_batch_format's list(v) wrap) — restack it
+                v = np.stack(v)
+            cols[c] = v
+        return cols
+    raise TypeError(f"batch UDF returned unsupported type {type(out)}")
+
+
+class _FormattedUDF:
+    """Stateful-UDF wrapper adding batch_format conversion around a user
+    class's __call__ (actor-pool map_batches with batch_format=)."""
+
+    def __init__(self, cls, fmt, *args):
+        self._inner = cls(*args)
+        self._fmt = fmt
+
+    def __call__(self, batch):
+        return _coerce_block(self._inner(_to_batch_format(batch,
+                                                          self._fmt)))
+
+
 def _apply_op(block: Block, op: tuple) -> Block:
     kind, fn = op[0], op[1]
     if kind == "map_batches":
@@ -149,15 +226,32 @@ class Dataset:
 
     def map_batches(self, fn: Callable[[Block], Block], *,
                     batch_size: Optional[int] = None,
+                    batch_format: Optional[str] = None,
                     compute: Optional["ActorPoolStrategy"] = None,
                     fn_constructor_args: tuple = ()) -> "Dataset":
         """batch_size re-slices each block before fn (ref: dataset.py:385
         map_batches(batch_size=...) — bounds the UDF's working set, e.g.
-        a model's device batch). A CLASS fn (or compute=
-        ActorPoolStrategy(...)) runs on a pool of stateful actors so
-        expensive setup — loading a model to the device — happens once
-        per actor, not once per block (ref:
+        a model's device batch). batch_format presents batches as
+        "numpy" (default), "pandas", or "pyarrow" and accepts the same
+        formats back (ref: map_batches(batch_format=...); Arrow
+        conversion is zero-copy per column where types allow). A CLASS
+        fn (or compute=ActorPoolStrategy(...)) runs on a pool of
+        stateful actors so expensive setup — loading a model to the
+        device — happens once per actor, not once per block (ref:
         _internal/execution/operators/actor_pool_map_operator.py)."""
+        if batch_format not in (None, "default", "numpy",
+                                "pandas", "pyarrow"):
+            raise ValueError(f"unsupported batch_format {batch_format!r}; "
+                             "use 'numpy', 'pandas', or 'pyarrow'")
+        if batch_format not in (None, "default", "numpy"):
+            fmt = batch_format
+            if isinstance(fn, type):
+                return self._map_batches_actors(
+                    _FormattedUDF, batch_size,
+                    compute or ActorPoolStrategy(),
+                    (fn, fmt, *fn_constructor_args))
+            user_fn = fn
+            fn = lambda b: _coerce_block(user_fn(_to_batch_format(b, fmt)))
         if compute is not None or isinstance(fn, type):
             return self._map_batches_actors(
                 fn, batch_size, compute or ActorPoolStrategy(),
@@ -331,11 +425,26 @@ class Dataset:
             return None
         return None
 
+    def columns(self) -> Optional[List[str]]:
+        """Column names (ref: Dataset.columns — schema().names there)."""
+        return self.schema()
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: Optional[str] = None):
+        """First up-to-batch_size rows as ONE batch (ref:
+        Dataset.take_batch)."""
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format):
+            return batch
+        return _to_batch_format({}, batch_format)
+
     def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
-                     local_shuffle_seed: Optional[int] = None):
+                     local_shuffle_seed: Optional[int] = None,
+                     batch_format: Optional[str] = None):
         return DataIterator(self._block_refs, self._ops).iter_batches(
             batch_size=batch_size, drop_last=drop_last,
-            local_shuffle_seed=local_shuffle_seed)
+            local_shuffle_seed=local_shuffle_seed,
+            batch_format=batch_format)
 
     def iter_torch_batches(self, **kw):
         return DataIterator(self._block_refs, self._ops).iter_torch_batches(
@@ -905,7 +1014,8 @@ class DataIterator:
         return Dataset(self._block_refs, self._ops)
 
     def iter_batches(self, *, batch_size: int = 256, drop_last: bool = False,
-                     local_shuffle_seed: Optional[int] = None):
+                     local_shuffle_seed: Optional[int] = None,
+                     batch_format: Optional[str] = None):
         rng = (np.random.default_rng(local_shuffle_seed)
                if local_shuffle_seed is not None else None)
         buf: List[Block] = []
@@ -926,9 +1036,9 @@ class DataIterator:
                 rest = _block_slice(whole, batch_size, _block_rows(whole))
                 buf = [rest]
                 rows_in_buf = _block_rows(rest)
-                yield batch
+                yield _to_batch_format(batch, batch_format)
         if rows_in_buf and not drop_last:
-            yield _block_concat(buf)
+            yield _to_batch_format(_block_concat(buf), batch_format)
 
     def iter_torch_batches(self, *, batch_size: int = 256,
                            drop_last: bool = False,
@@ -1055,8 +1165,7 @@ def read_parquet(paths) -> Dataset:
         import pyarrow.parquet as pq
 
         t = pq.read_table(path)
-        return {c: t[c].to_numpy(zero_copy_only=False)
-                for c in t.column_names}
+        return _arrow_to_block(t)
 
     return _read_files(paths, reader)
 
@@ -1066,8 +1175,7 @@ def read_csv(paths) -> Dataset:
         import pyarrow.csv as pc
 
         t = pc.read_csv(path)
-        return {c: t[c].to_numpy(zero_copy_only=False)
-                for c in t.column_names}
+        return _arrow_to_block(t)
 
     return _read_files(paths, reader)
 
@@ -1077,8 +1185,7 @@ def read_json(paths) -> Dataset:
         import pyarrow.json as pj
 
         t = pj.read_json(path)
-        return {c: t[c].to_numpy(zero_copy_only=False)
-                for c in t.column_names}
+        return _arrow_to_block(t)
 
     return _read_files(paths, reader)
 
@@ -1234,9 +1341,10 @@ def read_tfrecords(paths) -> Dataset:
 
 
 def from_arrow(table, *, num_blocks: int = 8) -> Dataset:
-    return from_numpy(
-        {c: table[c].to_numpy(zero_copy_only=False)
-         for c in table.column_names}, num_blocks=num_blocks)
+    """Arrow table -> Dataset; numeric columns become zero-copy numpy
+    views over the Arrow buffers (ref: from_arrow in read_api.py; the
+    copy happens only at the object-store put, as in the reference)."""
+    return from_numpy(_arrow_to_block(table), num_blocks=num_blocks)
 
 
 def read_sql(sql: str, connection_factory, *,
@@ -1356,3 +1464,67 @@ def read_webdataset(paths) -> Dataset:
         return _rows_to_block([samples[k] for k in order])
 
     return _read_files(paths, reader)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[dict]] = None,
+               parallelism: int = 1) -> Dataset:
+    """Read a MongoDB collection (ref: datasource/mongo_datasource.py —
+    pymongo there too; parallel reads partition on `_id` ranges).
+    Gated: pymongo is not in the TPU image."""
+    try:
+        import pymongo  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "read_mongo needs the pymongo package, which is not in the "
+            "TPU image; install it in your driver/worker environment"
+        ) from e
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _read(shard: int):
+        import pymongo
+
+        client = pymongo.MongoClient(uri)
+        coll = client[database][collection]
+        stages = list(pipeline or [])
+        if parallelism > 1:
+            # shard on a hash of _id (works for ObjectId AND scalar _id
+            # types; a timestamp-derived key would be second-granular —
+            # every ObjectId's ms value is a multiple of 1000, starving
+            # shards whenever parallelism shares a factor with 1000)
+            stages.insert(0, {"$match": {"$expr": {"$eq": [
+                {"$mod": [{"$abs": {"$toHashedIndexKey": "$_id"}},
+                          parallelism]}, shard]}}})
+        rows = []
+        for doc in coll.aggregate(stages) if stages else coll.find():
+            doc.pop("_id", None)
+            rows.append(doc)
+        client.close()
+        return _rows_to_block(rows)
+
+    return Dataset([_read.remote(i) for i in builtins.range(parallelism)],
+                   [])
+
+
+def read_bigquery(query: str, *, project: Optional[str] = None) -> Dataset:
+    """Read BigQuery results (ref: datasource/bigquery_datasource.py).
+    Gated: google-cloud-bigquery is not in the TPU image."""
+    try:
+        from google.cloud import bigquery  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "read_bigquery needs the google-cloud-bigquery package, "
+            "which is not in the TPU image; install it in your driver "
+            "environment") from e
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _read():
+        from google.cloud import bigquery as bq
+
+        client = bq.Client(project=project)
+        table = client.query(query).to_arrow()
+        return _arrow_to_block(table)
+
+    return Dataset([_read.remote()], [])
