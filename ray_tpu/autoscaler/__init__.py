@@ -12,8 +12,11 @@ FakeMultiNodeProvider, fake_multi_node/node_provider.py:237).
 """
 
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
-from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
+from ray_tpu.autoscaler.node_provider import (AWSProvider, GCEProvider,
+                                              KubernetesProvider,
+                                              LocalNodeProvider,
                                               NodeProvider, TPUPodProvider)
 
 __all__ = ["StandardAutoscaler", "NodeProvider", "LocalNodeProvider",
-           "TPUPodProvider"]
+           "TPUPodProvider", "GCEProvider", "AWSProvider",
+           "KubernetesProvider"]

@@ -1,0 +1,190 @@
+"""Sanitizer passes over the native object store (SURVEY §5.2: the
+reference CI builds its C++ core with TSAN/ASAN — .bazelrc configs,
+ci/ scripts. Here: the store sources are recompiled with
+-fsanitize=address / -fsanitize=thread into scratch .so files and a
+churn workload runs under each; the sanitizer runtime aborts the
+subprocess non-zero on any finding)."""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "ray_tpu", "native")
+SOURCES = [os.path.join(NATIVE_DIR, "objstore.cc"),
+           os.path.join(NATIVE_DIR, "xfer.cc")]
+
+# The churn driver run inside the sanitized subprocess: multi-process
+# (fork) create/seal/get/release/delete/evict traffic on one segment,
+# exercising the robust-mutex hot path, the allocator, and the reaper.
+DRIVER = r"""
+import ctypes, os, random, sys
+
+so, seg, nproc = sys.argv[1], sys.argv[2], int(sys.argv[3])
+lib = ctypes.CDLL(so)
+lib.ts_create.restype = ctypes.c_void_p
+lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+lib.ts_attach.restype = ctypes.c_void_p
+lib.ts_attach.argtypes = [ctypes.c_char_p]
+lib.ts_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                       ctypes.c_uint64]
+lib.ts_get.restype = ctypes.c_uint64
+lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                       ctypes.POINTER(ctypes.c_uint64)]
+lib.ts_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+lib.ts_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+lib.ts_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+lib.ts_create_buf.restype = ctypes.c_uint64
+lib.ts_create_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint64]
+lib.ts_evict.restype = ctypes.c_int
+lib.ts_evict.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+lib.ts_reap_creating.restype = ctypes.c_int
+lib.ts_reap_creating.argtypes = [ctypes.c_void_p, ctypes.c_double]
+lib.ts_destroy.argtypes = [ctypes.c_char_p]
+
+def oid(tag, i):
+    return (b"%02d" % tag) + i.to_bytes(4, "big") + b"x" * 14
+
+def churn(h, tag, iters):
+    rng = random.Random(tag)
+    payload = bytes(range(256)) * 16
+    live = []
+    for i in range(iters):
+        o = oid(tag, i)
+        n = rng.randrange(1, len(payload))
+        if rng.random() < 0.7:
+            lib.ts_put(h, o, payload[:n], n)
+            live.append(o)
+        else:
+            off = lib.ts_create_buf(h, o, n)
+            if off:
+                (lib.ts_seal if rng.random() < 0.8 else lib.ts_abort)(h, o)
+                live.append(o)
+        if live and rng.random() < 0.5:
+            pick = rng.choice(live)
+            sz = ctypes.c_uint64()
+            if lib.ts_get(h, pick, ctypes.byref(sz)):
+                lib.ts_release(h, pick)
+        if live and rng.random() < 0.3:
+            lib.ts_delete(h, live.pop(rng.randrange(len(live))))
+        if rng.random() < 0.05:
+            lib.ts_reap_creating(h, 0.0)
+
+h = lib.ts_create(seg.encode(), 4 << 20, 256)
+assert h, "create failed"
+pids = []
+for p in range(nproc):
+    pid = os.fork()
+    if pid == 0:
+        h2 = lib.ts_attach(seg.encode())
+        assert h2, "attach failed"
+        churn(h2, 10 + p, 300)
+        os._exit(0)
+    pids.append(pid)
+churn(h, 1, 300)
+fail = 0
+for pid in pids:
+    _, st = os.waitpid(pid, 0)
+    if st != 0:
+        fail = 1
+lib.ts_destroy(seg.encode())
+sys.exit(fail)
+"""
+
+# Threaded single-process variant for TSAN (process-shared mutexes across
+# forks are outside TSAN's model; in-process thread interleavings are
+# exactly what it checks).
+DRIVER_THREADS = DRIVER.replace(
+    '''pids = []
+for p in range(nproc):
+    pid = os.fork()
+    if pid == 0:
+        h2 = lib.ts_attach(seg.encode())
+        assert h2, "attach failed"
+        churn(h2, 10 + p, 300)
+        os._exit(0)
+    pids.append(pid)
+churn(h, 1, 300)
+fail = 0
+for pid in pids:
+    _, st = os.waitpid(pid, 0)
+    if st != 0:
+        fail = 1
+lib.ts_destroy(seg.encode())
+sys.exit(fail)''',
+    '''import threading
+threads = [threading.Thread(target=churn, args=(h, 10 + p, 300))
+           for p in range(nproc)]
+for t in threads:
+    t.start()
+churn(h, 1, 300)
+for t in threads:
+    t.join()
+lib.ts_destroy(seg.encode())
+sys.exit(0)''')
+
+
+def _sanitizer_lib(name: str):
+    out = subprocess.run(["g++", f"-print-file-name=lib{name}.so"],
+                         capture_output=True, text=True)
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) and os.path.exists(path) else None
+
+
+def _build(tmp: str, flag: str) -> str:
+    so = os.path.join(tmp, f"libobjstore_{flag.split('=')[-1]}.so")
+    cmd = ["g++", "-O1", "-g", "-fPIC", "-shared", "-std=c++17", flag,
+           "-o", so, *SOURCES, "-lpthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return so
+
+
+def _run(driver: str, so: str, preload: str, seg: str, nproc: int,
+         extra_env=None):
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = preload
+    # route Python allocations through malloc so the sanitizer sees the
+    # buffers the store reads from (pymalloc arenas are invisible to it;
+    # verified: an injected ts_put overread only trips ASAN with this)
+    env["PYTHONMALLOC"] = "malloc"
+    env.update(extra_env or {})
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(driver)
+        script = f.name
+    try:
+        return subprocess.run(
+            [sys.executable, script, so, seg, str(nproc)],
+            env=env, capture_output=True, text=True, timeout=600)
+    finally:
+        os.unlink(script)
+
+
+@pytest.mark.skipif(_sanitizer_lib("asan") is None,
+                    reason="libasan not available")
+def test_objstore_asan_clean(tmp_path):
+    so = _build(str(tmp_path), "-fsanitize=address")
+    res = _run(DRIVER, so, _sanitizer_lib("asan"),
+               f"rtx_asan_{os.getpid()}", nproc=2,
+               extra_env={"ASAN_OPTIONS":
+                          "detect_leaks=0:abort_on_error=1"})
+    assert res.returncode == 0, \
+        f"ASAN findings:\n{res.stderr[-4000:]}\n{res.stdout[-1000:]}"
+
+
+@pytest.mark.skipif(_sanitizer_lib("tsan") is None,
+                    reason="libtsan not available")
+def test_objstore_tsan_clean(tmp_path):
+    so = _build(str(tmp_path), "-fsanitize=thread")
+    res = _run(DRIVER_THREADS, so, _sanitizer_lib("tsan"),
+               f"rtx_tsan_{os.getpid()}", nproc=3,
+               extra_env={"TSAN_OPTIONS": "halt_on_error=1"})
+    assert res.returncode == 0, \
+        f"TSAN findings:\n{res.stderr[-4000:]}\n{res.stdout[-1000:]}"
