@@ -171,6 +171,93 @@ class DashboardHead:
     async def _h_jobs(self, request):
         return self._json(await self._gcs("list_jobs"))
 
+    # ------------------------------------------------ job submission REST
+    # (ref: dashboard/modules/job/job_head.py — POST /api/jobs/,
+    # GET /api/jobs/{id}, logs, stop; the SDK's http mode targets these)
+
+    def _job_client(self):
+        """Lazy driver connection for actor-backed job supervision (the
+        reference job head holds a JobManager the same way). Runs on the
+        executor thread — ray_tpu.init can block for the full connect
+        timeout and must never stall the dashboard's event loop."""
+        if getattr(self, "_jobs", None) is None:
+            import ray_tpu
+            from ray_tpu.job.manager import JobSubmissionClient
+
+            if not ray_tpu.is_initialized():
+                ray_tpu.init(
+                    address=f"{self.gcs_addr[0]}:{self.gcs_addr[1]}")
+            self._jobs = JobSubmissionClient()
+        return self._jobs
+
+    async def _job_call(self, method: str, *args, **kw):
+        """Resolve the client AND run the named method on the executor —
+        nothing ray-blocking touches the event loop."""
+        loop = asyncio.get_running_loop()
+
+        def run():
+            return getattr(self._job_client(), method)(*args, **kw)
+
+        return await loop.run_in_executor(None, run)
+
+    async def _h_job_submit(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        if "entrypoint" not in body:
+            return web.json_response(
+                {"error": "missing 'entrypoint'"}, status=400)
+        try:
+            job_id = await self._job_call(
+                "submit_job", entrypoint=body["entrypoint"],
+                runtime_env=body.get("runtime_env"),
+                working_dir=body.get("working_dir"),
+                submission_id=body.get("submission_id"))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return self._json({"job_id": job_id, "submission_id": job_id})
+
+    async def _h_job_list(self, request):
+        """Submission-API jobs (KV-backed), distinct from the cluster
+        driver-jobs table at /api/v0/jobs (ref: job_head.py list)."""
+        from aiohttp import web
+
+        try:
+            jobs = await self._job_call("list_jobs")
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return self._json(jobs)
+
+    async def _h_job_info(self, request):
+        from aiohttp import web
+
+        try:
+            info = await self._job_call("get_job_info",
+                                        request.match_info["job_id"])
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return self._json(info)
+
+    async def _h_job_logs(self, request):
+        from aiohttp import web
+
+        try:
+            logs = await self._job_call("get_job_logs",
+                                        request.match_info["job_id"])
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return self._json({"logs": logs})
+
+    async def _h_job_stop(self, request):
+        from aiohttp import web
+
+        try:
+            stopped = await self._job_call("stop_job",
+                                           request.match_info["job_id"])
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return self._json({"stopped": bool(stopped)})
+
     async def _h_summary(self, request):
         nodes = await self._gcs("get_nodes")
         actors = await self._gcs("list_actors")
@@ -331,6 +418,11 @@ class DashboardHead:
         app.router.add_get("/api/v0/actors", self._h_actors)
         app.router.add_get("/api/v0/tasks", self._h_tasks)
         app.router.add_get("/api/v0/jobs", self._h_jobs)
+        app.router.add_post("/api/jobs/", self._h_job_submit)
+        app.router.add_get("/api/jobs/", self._h_job_list)
+        app.router.add_get("/api/jobs/{job_id}", self._h_job_info)
+        app.router.add_get("/api/jobs/{job_id}/logs", self._h_job_logs)
+        app.router.add_post("/api/jobs/{job_id}/stop", self._h_job_stop)
         app.router.add_get("/api/v0/summary", self._h_summary)
         app.router.add_get("/api/v0/node_stats", self._h_node_stats)
         app.router.add_get("/metrics", self._h_metrics)

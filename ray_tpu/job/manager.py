@@ -86,17 +86,42 @@ class JobSupervisor:
 
 
 class JobSubmissionClient:
-    """ref: python/ray/job_submission SDK surface."""
+    """ref: python/ray/job_submission SDK surface. Two transports, like
+    the reference: an `http://host:port` address targets the dashboard
+    head's REST module (job_head.py routes); a `host:port` (or None)
+    address connects as a driver and supervises actors directly."""
 
     def __init__(self, address: Optional[str] = None):
+        self._http = None
+        if address and address.startswith("http"):
+            self._http = address.rstrip("/")
+            self._n = 0
+            return
         if not ray_tpu.is_initialized():
             ray_tpu.init(address=address)
         self._n = 0
+
+    # ---- http transport (ref: job SDK's _do_request) ----
+
+    def _rest(self, method: str, path: str, body: Optional[dict] = None):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._http + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read().decode())
 
     def submit_job(self, *, entrypoint: str,
                    runtime_env: Optional[dict] = None,
                    working_dir: Optional[str] = None,
                    submission_id: Optional[str] = None) -> str:
+        if self._http:
+            return self._rest("POST", "/api/jobs/", {
+                "entrypoint": entrypoint, "runtime_env": runtime_env,
+                "working_dir": working_dir,
+                "submission_id": submission_id})["job_id"]
         job_id = submission_id or f"raytpu-job-{int(time.time())}-{self._n}"
         self._n += 1
         sup = JobSupervisor.options(
@@ -115,18 +140,30 @@ class JobSubmissionClient:
         return ray_tpu.get_actor(f"_job_{job_id}", namespace="job")
 
     def get_job_status(self, job_id: str) -> str:
+        if self._http:
+            return self._rest("GET", f"/api/jobs/{job_id}")["status"]
         return ray_tpu.get(self._sup(job_id).status.remote())
 
     def get_job_logs(self, job_id: str) -> str:
+        if self._http:
+            return self._rest("GET", f"/api/jobs/{job_id}/logs")["logs"]
         return ray_tpu.get(self._sup(job_id).logs.remote())
 
     def get_job_info(self, job_id: str) -> dict:
+        if self._http:
+            return self._rest("GET", f"/api/jobs/{job_id}")
         return ray_tpu.get(self._sup(job_id).info.remote())
 
     def stop_job(self, job_id: str) -> bool:
+        if self._http:
+            return self._rest("POST",
+                              f"/api/jobs/{job_id}/stop")["stopped"]
         return ray_tpu.get(self._sup(job_id).stop.remote())
 
     def list_jobs(self) -> List[str]:
+        if self._http:
+            return [j if isinstance(j, str) else j.get("job_id")
+                    for j in self._rest("GET", "/api/jobs/")]
         from ray_tpu.core import runtime as rt
 
         return [k.decode() for k in

@@ -28,7 +28,12 @@ from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.trainer import JaxTrainer, Result, TorchTrainer
-from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
+from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
+                                     Predictor, TorchPredictor,
+                                     TransformersPredictor)
+from ray_tpu.train.huggingface import (AccelerateBackend,
+                                       AccelerateTrainer,
+                                       TransformersTrainer, shard_to_list)
 from ray_tpu.train.sklearn import (LightGBMTrainer, SklearnTrainer,
                                    XGBoostTrainer)
 from ray_tpu.train import session
@@ -36,8 +41,11 @@ from ray_tpu.train import session
 __all__ = [
     "JaxTrainer", "TorchTrainer", "Result", "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "Checkpoint", "session",
-    "Predictor", "JaxPredictor", "BatchPredictor",
+    "Predictor", "JaxPredictor", "BatchPredictor", "TorchPredictor",
+    "TransformersPredictor",
     "Backend", "JaxBackend", "TorchBackend", "prepare_model",
     "prepare_data_loader",
     "SklearnTrainer", "XGBoostTrainer", "LightGBMTrainer",
+    "TransformersTrainer", "AccelerateTrainer", "AccelerateBackend",
+    "shard_to_list",
 ]

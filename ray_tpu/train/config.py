@@ -48,3 +48,6 @@ class RunConfig:
     storage_path: Optional[str] = None    # default: ~/ray_tpu_results
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    # tune LoggerCallback instances (ref: air RunConfig.callbacks →
+    # tune/logger/*; see ray_tpu/tune/loggers.py)
+    callbacks: Optional[list] = None

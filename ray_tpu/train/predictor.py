@@ -111,3 +111,80 @@ class BatchPredictor:
         # pool yields in completion order; restore block order
         results.sort(key=lambda ib: ib[0])
         return Dataset([ray_tpu.put(b) for _, b in results], [])
+
+
+class TorchPredictor(Predictor):
+    """torch nn.Module predictor (ref: train/torch/torch_predictor.py) —
+    the host-side migration path; the device path is JaxPredictor."""
+
+    def __init__(self, model, feature_column: str = "features",
+                 output_column: str = "predictions"):
+        import torch
+
+        self.model = model.eval()
+        self.torch = torch
+        self.feature_column = feature_column
+        self.output_column = output_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *, model=None,
+                        **kwargs) -> "TorchPredictor":
+        """`model` is the architecture; the checkpoint supplies a
+        state_dict under "model" (or IS the state_dict). Non-array
+        entries riding in the dict (epoch counters etc.) are ignored."""
+        import torch
+
+        if model is None:
+            raise ValueError(
+                "TorchPredictor.from_checkpoint needs model= (the "
+                "architecture to load the checkpoint's state_dict into)")
+        state = checkpoint.load_state()
+        if isinstance(state, dict):
+            sd = state.get("model", state)
+            tensors = {k: torch.as_tensor(np.asarray(v))
+                       for k, v in sd.items()
+                       if hasattr(v, "shape") or torch.is_tensor(v)}
+            if not tensors:
+                raise ValueError(
+                    f"checkpoint holds no array state for the model "
+                    f"(keys: {list(sd)[:8]})")
+            model.load_state_dict(tensors)
+        return cls(model, **kwargs)
+
+    def predict(self, batch):
+        import torch
+
+        x = torch.as_tensor(np.asarray(batch[self.feature_column]))
+        with torch.no_grad():
+            out = self.model(x)
+        result = dict(batch)
+        result[self.output_column] = out.numpy()
+        return result
+
+
+class TransformersPredictor(Predictor):
+    """HF pipeline predictor (ref:
+    train/huggingface/transformers_predictor.py — wraps a transformers
+    pipeline over text batches)."""
+
+    def __init__(self, pipeline, feature_column: str = "text",
+                 output_column: str = "predictions"):
+        self.pipeline = pipeline
+        self.feature_column = feature_column
+        self.output_column = output_column
+
+    @classmethod
+    def from_pretrained(cls, task: str, model: str,
+                        **kwargs) -> "TransformersPredictor":
+        from transformers import pipeline as hf_pipeline
+
+        return cls(hf_pipeline(task, model=model, device=-1), **kwargs)
+
+    def predict(self, batch):
+        texts = [str(t) for t in batch[self.feature_column]]
+        out = self.pipeline(texts)
+        result = dict(batch)
+        result[self.output_column] = np.asarray(
+            [o.get("label", o) if isinstance(o, dict) else o
+             for o in out], dtype=object)
+        return result

@@ -28,6 +28,9 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      PopulationBasedTraining)
 from ray_tpu.tune.tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
                                 get_checkpoint, get_trial_context, report)
+from ray_tpu.tune.loggers import (CSVLoggerCallback, JsonLoggerCallback,
+                                  LoggerCallback, MLflowLoggerCallback,
+                                  TBXLoggerCallback, WandbLoggerCallback)
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "report",
@@ -37,4 +40,6 @@ __all__ = [
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
     "BayesOptSearch",
     "ConcurrencyLimiter",
+    "LoggerCallback", "CSVLoggerCallback", "JsonLoggerCallback",
+    "TBXLoggerCallback", "MLflowLoggerCallback", "WandbLoggerCallback",
 ]

@@ -234,8 +234,22 @@ class Tuner:
         launched = 0 if searcher is not None else total
         running: Dict[str, dict] = {}
         results: Dict[str, TrialResult] = {}
+        # logger callbacks (ref: RunConfig.callbacks → tune/logger/*)
+        callbacks = list(getattr(self.run_config, "callbacks", None) or [])
+        if callbacks:
+            cb_dir = self._run_dir() or os.path.join(
+                os.path.expanduser("~/ray_tpu_results"),
+                f"tune_{int(time.time())}")
+            os.makedirs(cb_dir, exist_ok=True)
+            for cb in callbacks:
+                cb.setup(cb_dir)
+        started: set = set()
 
         def launch(trial_id: str, cfg: dict, start_checkpoint=None):
+            if trial_id not in started:
+                started.add(trial_id)
+                for cb in callbacks:
+                    cb.on_trial_start(trial_id, cfg)
             actor = _TrialActor.options(
                 resources=dict(tc.resources_per_trial),
                 max_concurrency=2).remote(trial_id, cfg, start_checkpoint)
@@ -250,6 +264,8 @@ class Tuner:
 
         def finish(tid: str, res: TrialResult, error: bool):
             results[tid] = res
+            for cb in callbacks:
+                cb.on_trial_complete(tid, res)
             if searcher is not None:
                 searcher.on_trial_complete(
                     tid, {**res.metrics, "config": res.config}, error=error)
@@ -295,6 +311,8 @@ class Tuner:
                     r = {**r, "config": res.config}
                     res.metrics_history.append(r)
                     res.metrics = r
+                    for cb in callbacks:
+                        cb.on_trial_result(tid, r)
                     decision = scheduler.on_result(tid, r)
                     if decision == STOP and not poll["done"]:
                         try:
@@ -328,16 +346,21 @@ class Tuner:
                     del running[tid]
                     finish(tid, res, error=bool(res.error))
         ordered = [results[tid] for tid in sorted(results)]
+        for cb in callbacks:
+            cb.on_experiment_end(ordered)
         self._save_experiment_state(ordered)
         return ResultGrid(ordered, tc.metric, tc.mode)
 
-    def _save_experiment_state(self, results: List[TrialResult]):
-        run_dir = None
+    def _run_dir(self) -> Optional[str]:
         if self.run_config is not None:
             base = getattr(self.run_config, "storage_path", None)
             name = getattr(self.run_config, "name", None)
             if base and name:
-                run_dir = os.path.join(base, name)
+                return os.path.join(base, name)
+        return None
+
+    def _save_experiment_state(self, results: List[TrialResult]):
+        run_dir = self._run_dir()
         if run_dir is None:
             return
         os.makedirs(run_dir, exist_ok=True)

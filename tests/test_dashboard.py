@@ -126,3 +126,40 @@ def test_grafana_provisioning(tmp_path):
     # every panel graphs a series the head actually exports
     assert "raytpu_object_store_bytes_in_use" in exprs
     assert "127.0.0.1:1234" in (tmp_path / "prometheus.yml").read_text()
+
+
+def test_job_rest_api(dash):
+    """REST job submission module (ref: dashboard/modules/job/job_head.py
+    POST /api/jobs/, GET info/logs, POST stop) driven through the
+    http-mode JobSubmissionClient (ref: job SDK http transport)."""
+    import time
+
+    from ray_tpu.job.manager import JobSubmissionClient, JobStatus
+
+    client = JobSubmissionClient(dash)          # http:// address
+    job_id = client.submit_job(
+        entrypoint="python -c \"print('from rest job')\"")
+    assert job_id
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(job_id) == JobStatus.SUCCEEDED:
+            break
+        time.sleep(0.5)
+    assert client.get_job_status(job_id) == JobStatus.SUCCEEDED
+    assert "from rest job" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info["job_id"] == job_id and info["status"] == "SUCCEEDED"
+    assert job_id in client.list_jobs()
+
+
+def test_job_rest_validation(dash):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(dash + "/api/jobs/", method="POST",
+                                 data=b"{}",
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400

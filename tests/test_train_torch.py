@@ -113,3 +113,25 @@ def test_prepare_data_loader_shards(cluster, tmp_path):
     assert res.ok, res.error
     # DistributedSampler gives each of the 2 ranks half the 32 rows
     assert all(m["seen"] == 16 for m in res.metrics_history)
+
+
+def test_torch_predictor_roundtrip(tmp_path):
+    """TorchPredictor.from_checkpoint restores a state_dict and predicts
+    (ref: train/torch/torch_predictor.py)."""
+    import numpy as np
+    import torch
+
+    from ray_tpu.train import Checkpoint, TorchPredictor
+
+    model = torch.nn.Linear(3, 2)
+    ckpt_dir = str(tmp_path / "ck")
+    Checkpoint.from_state(
+        {"model": {k: v.numpy() for k, v in model.state_dict().items()}},
+        ckpt_dir)
+    pred = TorchPredictor.from_checkpoint(
+        Checkpoint(ckpt_dir), model=torch.nn.Linear(3, 2))
+    x = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+    out = pred.predict({"features": x})
+    assert out["predictions"].shape == (8, 2)
+    ref = model(torch.as_tensor(x)).detach().numpy()
+    assert np.allclose(out["predictions"], ref, atol=1e-6)
