@@ -58,6 +58,16 @@ def run_microbenchmarks(which: Optional[List[str]] = None,
     def want(name: str) -> bool:
         return not which or any(w in name for w in which)
 
+    # Pool warmup before any measurement (ref: ray_perf.py benchmarks
+    # run against a warm cluster; ray prestarts workers at init): a
+    # fractional-CPU fan-out forces the worker pool to steady state so
+    # the first benchmarks don't measure worker spawn + jax import.
+    # Skipped when only object-plane benches run — they need no workers.
+    if any(want(n) for n in ("task_single", "task_batch", "task_args",
+                             "actor")):
+        ray_tpu.get([_noop.options(num_cpus=0.1).remote()
+                     for _ in range(16)])
+
     # --- object plane (ref: ray_perf.py put/get benchmarks)
     if want("put_small"):
         def put_small():

@@ -43,7 +43,11 @@ class Config:
     object_spill_dir: str = ""                   # default: <session>/spill
     # --- scheduler / raylet -------------------------------------------------
     worker_lease_timeout_s: float = 30.0
-    worker_pool_prestart: int = 0
+    # -1 = auto: min(node CPU total, 2) workers spawn at node start (ref:
+    # worker_pool.h prestart — the reference raylet prestarts num_cpus
+    # python workers; a cold pool makes the first task waves pay worker
+    # spawn + the lease-grant race serially)
+    worker_pool_prestart: int = -1
     max_workers_per_node: int = 8
     worker_idle_timeout_s: float = 300.0
     scheduler_spread_threshold: float = 0.5      # ref: RAY_scheduler_spread_threshold

@@ -21,12 +21,13 @@ import struct
 
 class BaseID:
     SIZE = 20
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, b: bytes):
         if len(b) != self.SIZE:
             raise ValueError(f"{type(self).__name__} needs {self.SIZE} bytes, got {len(b)}")
         self._bytes = b
+        self._hash = None
 
     @classmethod
     def from_random(cls):
@@ -53,7 +54,28 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        # ids key every hot-path dict (directory, refcounts, queues);
+        # cache the hash — it's taken dozens of times per task
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((type(self).__name__, self._bytes))
+        return h
+
+    # The cache must NOT cross process boundaries: bytes hashing is
+    # per-process salted (PYTHONHASHSEED), so a shipped cached hash
+    # would disagree with locally-constructed equal ids and silently
+    # miss every dict probe (observed: workers "not found" at their own
+    # nodelet, actors never alive).
+    def __getstate__(self):
+        return self._bytes
+
+    def __setstate__(self, state):
+        if isinstance(state, tuple):
+            # legacy slots format ((None, {"_bytes": ...})) from state
+            # files written before __getstate__ existed
+            state = state[1]["_bytes"]
+        self._bytes = state
+        self._hash = None
 
     def __repr__(self):
         return f"{type(self).__name__}({self.hex()[:16]})"

@@ -270,3 +270,37 @@ def test_native_transfer_plane_carries_pull(ray_start_cluster):
         assert stats["xfer_port"] > 0
         native_pulls += stats["native_pulls"]
     assert native_pulls >= 1
+
+
+def test_placement_group_task_on_remote_bundle_node(ray_start_cluster):
+    """PG-task leases must target the BUNDLE's node: with the bundle
+    forced onto a node other than the driver's, the lease request would
+    loop "bundle not here" against the driver's nodelet forever
+    (regression: surfaced when bundle packing switched to
+    least-utilized placement; ref: PG dispatch against the reserving
+    raylet)."""
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 0.5})    # head = driver's node
+    cluster.add_node(resources={"CPU": 2.0})    # only here bundles fit
+    cluster.connect()
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=15)
+    table = pg.table()
+    bundle_node = table["bundles"][0]["node_id"]
+
+    from ray_tpu.core.runtime import get_runtime
+
+    nodes = get_runtime().gcs_call("get_nodes")
+    bundle_addr = next(tuple(n.nodelet_addr) for n in nodes
+                       if n.node_id == bundle_node)
+
+    @ray_tpu.remote(num_cpus=1,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=0))
+    def where():
+        from ray_tpu.core.runtime import get_runtime
+
+        return tuple(get_runtime().nodelet_addr)
+
+    assert ray_tpu.get(where.remote(), timeout=60) == bundle_addr
