@@ -317,6 +317,12 @@ class Runtime:
         return fut.result(timeout)
 
     def _spawn(self, coro):
+        if self._shutdown:
+            # late GC callbacks (ref drops during teardown) must not
+            # enqueue work a stopping loop will never run — an enqueued-
+            # but-never-created task leaks an un-awaited coroutine
+            coro.close()
+            return
         try:
             if self.loop_thread is not None:
                 self.loop_thread.spawn(coro)
