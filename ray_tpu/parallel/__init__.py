@@ -14,11 +14,14 @@ bootstrap; XLA emits the psum/all-gather/reduce-scatter/ppermute over ICI.
     step = make_train_step(model, rules, mesh)   # see ray_tpu.train
 """
 
-from ray_tpu.parallel.mesh import MeshSpec, build_mesh, local_mesh
+from ray_tpu.parallel.mesh import (DCNSpec, MeshSpec,
+                                   build_hybrid_mesh, build_mesh,
+                                   local_mesh)
 from ray_tpu.parallel.sharding import (ShardingRules, logical_to_mesh,
                                        shard_params, named_sharding)
 
 __all__ = [
     "MeshSpec", "build_mesh", "local_mesh", "ShardingRules",
+    "DCNSpec", "build_hybrid_mesh",
     "logical_to_mesh", "shard_params", "named_sharding",
 ]
