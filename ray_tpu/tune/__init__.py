@@ -19,7 +19,9 @@ search algorithms (search/basic_variant.py grid/random), trial schedulers
     best = results.get_best_result()
 """
 
-from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
+from ray_tpu.tune.search import (AxSearch, BasicVariantGenerator,
+                                 ConcurrencyLimiter, HyperOptSearch,
+                                 OptunaSearch, TuneBOHB,
                                  BayesOptSearch, RandomSearch, Searcher,
                                  TPESearcher, choice,
                                  grid_search, loguniform, randint, uniform)
@@ -40,6 +42,7 @@ __all__ = [
     "Searcher", "BasicVariantGenerator", "RandomSearch", "TPESearcher",
     "BayesOptSearch",
     "ConcurrencyLimiter",
+    "OptunaSearch", "HyperOptSearch", "TuneBOHB", "AxSearch",
     "LoggerCallback", "CSVLoggerCallback", "JsonLoggerCallback",
     "TBXLoggerCallback", "MLflowLoggerCallback", "WandbLoggerCallback",
 ]

@@ -428,3 +428,28 @@ class BayesOptSearch(Searcher):
             self._y.append(val)
         except (KeyError, ValueError):
             pass
+
+
+def _gated_searcher(name: str, package: str):
+    """External-library searcher surface (ref: tune/search/{optuna,
+    hyperopt,bohb,ax}.py — thin wrappers over optional packages). The
+    TPU image ships none of them; constructing one raises with install
+    guidance. In-image equivalents: TPESearcher (HyperOpt/Optuna-class
+    TPE) and BayesOptSearch (GP+EI)."""
+
+    class _Gated(Searcher):
+        def __init__(self, *a, **k):
+            raise ImportError(
+                f"{name} needs the '{package}' package, which is not in "
+                f"the TPU image. Install it in your driver environment, "
+                f"or use the in-image TPESearcher / BayesOptSearch.")
+
+    _Gated.__name__ = name
+    _Gated.__qualname__ = name
+    return _Gated
+
+
+OptunaSearch = _gated_searcher("OptunaSearch", "optuna")
+HyperOptSearch = _gated_searcher("HyperOptSearch", "hyperopt")
+TuneBOHB = _gated_searcher("TuneBOHB", "hpbandster")
+AxSearch = _gated_searcher("AxSearch", "ax-platform")
