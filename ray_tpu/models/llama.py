@@ -41,6 +41,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16        # activation/compute dtype
     param_dtype: Any = jnp.float32   # master weights
     attn_impl: str = "xla"           # "xla" | "flash" | "ring"
+    # Mistral-style sliding-window attention: each query sees only the
+    # last `sliding_window` keys (None = full causal). Flash skips
+    # blocks outside the band (O(S*W) compute); xla and decode_step
+    # apply the band mask (the decode cache stays max_seq-sized; only
+    # the attention is banded). Unsupported with ring/ulysses.
+    sliding_window: Any = None
     remat: bool = True               # jax.checkpoint each layer (HBM savings)
 
     @property
@@ -151,7 +157,7 @@ def apply_rope(x, cos, sin):
                            axis=-1).astype(x.dtype)
 
 
-def _attention_xla(q, k, v, causal: bool, q_offset=0):
+def _attention_xla(q, k, v, causal: bool, q_offset=0, window=None):
     """Plain einsum attention; XLA fuses this well on TPU for moderate S.
     q: [B, S, H, D], k/v: [B, T, KV, D] (GQA broadcast)."""
     B, S, H, D = q.shape
@@ -165,6 +171,8 @@ def _attention_xla(q, k, v, causal: bool, q_offset=0):
         qpos = jnp.arange(S)[:, None] + q_offset
         kpos = jnp.arange(T)[None, :]
         mask = qpos >= kpos
+        if window is not None:
+            mask = mask & (qpos - kpos < window)
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
@@ -172,10 +180,22 @@ def _attention_xla(q, k, v, causal: bool, q_offset=0):
 
 
 def _attention(q, k, v, cfg: LlamaConfig, causal=True, q_offset=0):
-    if cfg.attn_impl == "flash" and causal and q.shape[1] >= 128:
+    win = cfg.sliding_window
+    if win is not None and cfg.attn_impl in ("ring", "ulysses"):
+        # silently computing FULL attention here would train a different
+        # model than the config describes
+        raise ValueError(
+            "sliding_window is not supported with ring/ulysses attention "
+            "(the band would have to chase blocks around the ring); use "
+            "attn_impl='flash' or 'xla' for windowed models")
+    # flash builds positions from 0, so offset chunks (cache prefill
+    # continuation) must take the xla path, which honors q_offset
+    at_origin = isinstance(q_offset, int) and q_offset == 0
+    if cfg.attn_impl == "flash" and causal and q.shape[1] >= 128 \
+            and at_origin:
         from ray_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True, window=win)
     if cfg.attn_impl == "ring":
         from ray_tpu.ops.ring_attention import ring_attention
 
@@ -184,7 +204,7 @@ def _attention(q, k, v, cfg: LlamaConfig, causal=True, q_offset=0):
         from ray_tpu.ops.ulysses import ulysses_attention
 
         return ulysses_attention(q, k, v, axis_name="sp")
-    return _attention_xla(q, k, v, causal, q_offset)
+    return _attention_xla(q, k, v, causal, q_offset, window=win)
 
 
 def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None, collect_kv=False):
@@ -413,6 +433,10 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
     S = cache.k.shape[2]
     kpos = jnp.arange(S)[None, :]                         # [1, S]
     attn_mask = (kpos <= pos[:, None]) & (active[:, None] > 0)  # [B, S]
+    if cfg.sliding_window is not None:
+        # banded decode matches banded training: only the last W cached
+        # keys are visible (cache layout unchanged)
+        attn_mask = attn_mask & (pos[:, None] - kpos < cfg.sliding_window)
 
     def body(x, inp):
         lp, ck, cv = inp                                   # ck: [B, S, KV, HD]

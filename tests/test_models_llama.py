@@ -144,3 +144,54 @@ def test_sp_ulysses_training_step():
     ref_loss = float(llama.loss_fn(params_host, batch, cfg_ref))
     assert np.isfinite(sp_loss)
     np.testing.assert_allclose(sp_loss, ref_loss, rtol=2e-3)
+
+
+def test_sliding_window_attention():
+    """cfg.sliding_window bands the attention: positions inside the
+    window match full causal exactly, later positions diverge (xla
+    path; the flash path is validated in test_ops_attention.py)."""
+    import numpy as np
+
+    cfg = llama.PRESETS["tiny"].replace(remat=False, dtype=jnp.float32,
+                                        sliding_window=16)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 64)), jnp.int32)
+    banded = llama.forward(params, toks, cfg)
+    full = llama.forward(params, toks, cfg.replace(sliding_window=None))
+    assert float(jnp.abs(banded[:, :16] - full[:, :16]).max()) < 1e-5
+    assert float(jnp.abs(banded[:, -1] - full[:, -1]).max()) > 1e-3
+
+
+def test_sliding_window_decode_and_guards():
+    """decode_step applies the same band as training (identical to
+    full-causal decode before W, diverges after); ring/ulysses reject
+    sliding_window instead of silently computing full attention."""
+    import numpy as np
+
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, 1000, (1, 24)), jnp.int32)
+
+    def decode_all(W):
+        cfg = llama.PRESETS["tiny"].replace(remat=False,
+                                            dtype=jnp.float32,
+                                            sliding_window=W)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        cache = llama.init_cache(cfg, batch=1, max_seq=24)
+        outs = []
+        for t in range(24):
+            lg, cache = llama.decode_step(params, toks[:, t:t + 1],
+                                          cache, cfg)
+            outs.append(lg)
+        return jnp.stack(outs, 1)
+
+    full, win = decode_all(None), decode_all(8)
+    assert float(jnp.abs(win[:, :8] - full[:, :8]).max()) == 0.0
+    assert float(jnp.abs(win[:, -1] - full[:, -1]).max()) > 1e-3
+
+    cfg = llama.PRESETS["tiny"].replace(remat=False, dtype=jnp.float32,
+                                        sliding_window=8,
+                                        attn_impl="ring")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="sliding_window"):
+        llama.forward(params, toks, cfg)
