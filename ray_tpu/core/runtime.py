@@ -1747,7 +1747,15 @@ class Runtime:
         if self._actor_addr.get(actor_id) == tuple(addr):
             self._actor_addr[actor_id] = None
         self.pool.drop(tuple(addr))
-        if isinstance(err, RemoteError):
+        # "no actor hosted here" is a STALE ADDRESS, not an execution
+        # error: the actor re-drove onto a different worker (GCS failover
+        # mid-creation, or restart). The task provably never ran, so
+        # re-resolving and resending is a delivery retry that must not
+        # consume max_task_retries (ref: the direct actor submitter
+        # resends undelivered tasks on reconnect without counting them).
+        stale_addr = (isinstance(err, RemoteError)
+                      and "no actor hosted here" in str(err))
+        if isinstance(err, RemoteError) and not stale_addr:
             # Handler raised (not a transport failure): surface to caller.
             self._fail_task_returns(spec, err)
             return
@@ -1757,7 +1765,11 @@ class Runtime:
         except Exception:
             view = None
         state = (view or {}).get("state")
-        if retries != 0 and state != "DEAD":
+        if stale_addr and state != "DEAD":
+            await asyncio.sleep(0.3)
+            self._actor_queue(actor_id).append((spec, retries))
+            self._spawn(self._actor_sender(actor_id))
+        elif retries != 0 and state != "DEAD":
             await asyncio.sleep(0.3)
             self._actor_queue(actor_id).append(
                 (spec, retries - 1 if retries > 0 else -1))
