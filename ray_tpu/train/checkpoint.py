@@ -1,26 +1,66 @@
-"""Checkpoints: directory-backed, jax-pytree aware.
+"""Checkpoints: directory-backed, jax-pytree aware, URI-portable.
 
-Reference: python/ray/air/checkpoint.py (dict/dir/URI morphable Checkpoint)
-and Train's TuneCheckpointManager. Here a Checkpoint is a directory; pytrees
-of jax/numpy arrays are saved with orbax (standard TPU checkpointing, works
-for sharded arrays on multi-host) with a msgpack-free fallback to npz +
-pickle for plain python payloads.
+Reference: python/ray/air/checkpoint.py (dict/dir/URI morphable Checkpoint),
+python/ray/air/_internal/remote_storage.py (cloud persistence) and Train's
+TuneCheckpointManager. A Checkpoint is a directory; pytrees of jax arrays
+are saved with orbax (tensorstore OCDBT — each process writes only its
+addressable shards, so multi-host sharded state saves without gathering),
+with treedef + non-array leaves pickled alongside. `to_uri`/`from_uri` morph
+a checkpoint to/from remote storage (file:// memory:// gs:// s3://).
 """
 
 from __future__ import annotations
 
-import json
 import os
 import pickle
 import shutil
 import tempfile
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train import storage
+
+_ARRAYS_SUBDIR = "arrays"
+_AUX_FILE = "aux.pkl"
+#: written last to a mirrored checkpoint URI — a remote copy without it is
+#: a partial upload and is never restored from
+_REMOTE_MARKER = ".ray_tpu_complete"
+
+
+def _is_array_leaf(x: Any) -> bool:
+    import numpy as np
+
+    try:
+        import jax
+
+        if isinstance(x, jax.Array):
+            return True
+    except Exception:
+        pass
+    return isinstance(x, (np.ndarray, np.generic, int, float, bool, complex))
+
+
+_checkpointer = None
+
+
+def _get_checkpointer():
+    """Singleton orbax StandardCheckpointer (async under the hood; callers
+    wait via wait_until_finished)."""
+    global _checkpointer
+    if _checkpointer is None:
+        import orbax.checkpoint as ocp
+
+        _checkpointer = ocp.StandardCheckpointer()
+    return _checkpointer
 
 
 class Checkpoint:
-    def __init__(self, path: str):
+    def __init__(self, path: str, uri: Optional[str] = None):
         self.path = os.path.abspath(path)
+        #: remote home of this checkpoint, when it has one — carried through
+        #: pickling so a worker on another node can re-download (ref:
+        #: air Checkpoint URI morphs)
+        self.uri = uri
 
     # --- constructors -------------------------------------------------------
 
@@ -38,76 +78,428 @@ class Checkpoint:
         return cls(path)
 
     @classmethod
-    def from_state(cls, state: Any, path: str) -> "Checkpoint":
-        """Save a jax pytree (TrainState, params, ...) with orbax."""
-        os.makedirs(path, exist_ok=True)
+    def from_state(cls, state: Any, path: str,
+                   async_save: bool = False) -> "Checkpoint":
+        """Save a jax pytree (TrainState, params, ...) with orbax.
+
+        Array leaves go through orbax StandardCheckpointer — sharded
+        jax.Arrays are written shard-by-shard from their owning processes
+        (works multi-host without any device_get/gather). Non-array leaves
+        (callables, configs) plus the treedef are pickled to aux.pkl and
+        re-attached at load. With async_save the tensorstore writes happen
+        in the background; `wait()` (or the next save) joins them.
+        """
         import jax
 
-        host_state = jax.device_get(state)
-        with open(os.path.join(path, "state.pkl"), "wb") as f:
-            pickle.dump(host_state, f)
+        os.makedirs(path, exist_ok=True)
+        multiproc = jax.process_count() > 1
+        primary = jax.process_index() == 0
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+
+        def to_orbax(leaf) -> bool:
+            if not _is_array_leaf(leaf):
+                return False
+            if not multiproc:
+                return True
+            # Multi-host: orbax can only serialize globally-sharded
+            # jax.Arrays (each process writes its addressable shards).
+            # Host-local leaves (scalars, numpy, single-device arrays —
+            # replicated by construction in SPMD training) ride aux.pkl,
+            # written by process 0 alone.
+            return isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+
+        arrays = {str(i): leaf for i, leaf in enumerate(leaves)
+                  if to_orbax(leaf)}
+        others = {i: _to_host(leaf)
+                  for i, leaf in enumerate(leaves) if not to_orbax(leaf)}
+        if primary:
+            with open(os.path.join(path, _AUX_FILE), "wb") as f:
+                pickle.dump({"treedef": treedef, "others": others,
+                             "n": len(leaves), "ts": time.time(),
+                             "procs": jax.process_count()}, f)
+        arrays_dir = os.path.join(path, _ARRAYS_SUBDIR)
+        if arrays:
+            ckptr = _get_checkpointer()
+            ckptr.wait_until_finished()  # serialize with a previous async save
+            if primary and os.path.exists(arrays_dir):
+                shutil.rmtree(arrays_dir)
+            if multiproc:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("ray_tpu_ckpt_clean")
+            ckptr.save(arrays_dir, arrays)
+            if not async_save:
+                ckptr.wait_until_finished()
         return cls(path)
+
+    @classmethod
+    def from_uri(cls, uri: str, local_dir: Optional[str] = None) -> "Checkpoint":
+        """Download a checkpoint from remote storage
+        (ref: air/checkpoint.py Checkpoint.from_uri)."""
+        d = local_dir or tempfile.mkdtemp(prefix="ckpt_dl_")
+        storage.download_from_uri(uri, d)
+        return cls(d, uri=uri)
 
     # --- accessors ----------------------------------------------------------
 
     def to_dict(self) -> Dict[str, Any]:
+        self._ensure_local()
         p = os.path.join(self.path, "payload.pkl")
         with open(p, "rb") as f:
             return pickle.load(f)
 
-    def load_state(self) -> Any:
-        with open(os.path.join(self.path, "state.pkl"), "rb") as f:
-            return pickle.load(f)
+    def load_state(self, abstract_state: Any = None) -> Any:
+        """Load the pytree saved by from_state.
+
+        abstract_state: optional pytree of the same structure whose array
+        leaves are jax.Arrays or jax.ShapeDtypeStruct (with `.sharding`
+        set for a sharded restore) — orbax then places each restored array
+        directly onto its target devices/sharding, which is how a
+        multi-host TrainState comes back resident without a host round
+        trip. Without it, arrays restore as host-local numpy-backed
+        jax.Arrays (single-process only).
+        """
+        self._ensure_local()
+        legacy = os.path.join(self.path, "state.pkl")
+        if os.path.exists(legacy):  # pre-orbax format
+            with open(legacy, "rb") as f:
+                return pickle.load(f)
+        import jax
+
+        with open(os.path.join(self.path, _AUX_FILE), "rb") as f:
+            aux = pickle.load(f)
+        arrays_dir = os.path.join(self.path, _ARRAYS_SUBDIR)
+        array_idx = [i for i in range(aux["n"]) if i not in aux["others"]]
+        restored: Dict[str, Any] = {}
+        if array_idx:
+            ckptr = _get_checkpointer()
+            ckptr.wait_until_finished()
+            if abstract_state is not None:
+                tleaves = jax.tree_util.tree_flatten(abstract_state)[0]
+                if len(tleaves) != aux["n"]:
+                    raise ValueError(
+                        f"abstract_state has {len(tleaves)} leaves; "
+                        f"checkpoint has {aux['n']}")
+                target = {str(i): _abstract(tleaves[i]) for i in array_idx}
+            else:
+                # Host restore: build the target from orbax metadata with
+                # single-device placement, so a checkpoint saved on a
+                # bigger topology (16-device pod) still loads on this
+                # process (e.g. the driver inspecting a result).
+                sds = jax.sharding.SingleDeviceSharding(
+                    jax.local_devices()[0])
+                im = ckptr.metadata(arrays_dir).item_metadata
+                meta = getattr(im, "tree", im)
+                target = {k: jax.ShapeDtypeStruct(m.shape, m.dtype,
+                                                  sharding=sds)
+                          for k, m in meta.items()}
+            restored = ckptr.restore(arrays_dir, target)
+        leaves = [aux["others"][i] if i in aux["others"] else restored[str(i)]
+                  for i in range(aux["n"])]
+        return jax.tree_util.tree_unflatten(aux["treedef"], leaves)
 
     def to_directory(self) -> str:
         """ref: air/checkpoint.py Checkpoint.to_directory — a Checkpoint
-        IS a directory here, so this is the identity accessor."""
+        IS a directory here, so this is the identity accessor (plus a
+        lazy download when the data still lives at the URI)."""
+        self._ensure_local()
         return self.path
+
+    def to_uri(self, uri: str, write_marker: bool = True) -> str:
+        """Upload this checkpoint to remote storage
+        (ref: air/checkpoint.py Checkpoint.to_uri). The completion marker
+        is written last so a partial upload is never restored from;
+        multi-rank mirrors pass write_marker=False and let rank 0 write it
+        after a cross-host barrier."""
+        self.wait()
+        storage.upload_to_uri(self.path, uri)
+        if write_marker:
+            storage.touch_at_uri(storage.join_uri(uri, _REMOTE_MARKER))
+        self.uri = uri
+        return uri
+
+    def wait(self) -> None:
+        """Join any in-flight async orbax save for this process."""
+        if _checkpointer is not None:
+            _checkpointer.wait_until_finished()
 
     def exists(self) -> bool:
         return os.path.isdir(self.path) and bool(os.listdir(self.path))
 
+    def _ensure_local(self) -> None:
+        """Download from the URI when the local copy is absent or partial
+        (a checkpoint pickled to a worker on another node, or a staging
+        dir truncated by a crash). Lazy: runs at first read, so handles
+        that merely pass a checkpoint around never transfer data. An
+        flock serializes same-host readers racing to populate the same
+        staging dir (note: the whole directory is fetched — selective
+        per-shard reads straight from gs:// via tensorstore are a future
+        optimization)."""
+        if _complete(self.path) or not self.uri:
+            return
+        import fcntl
+
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path + ".lock", "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            if _complete(self.path):  # loser of the race: winner populated
+                return
+            if not CheckpointManager._marked(self.uri):
+                raise RuntimeError(
+                    f"remote checkpoint {self.uri} has no completion "
+                    f"marker (upload still running or died); refusing to "
+                    f"restore a partial copy")
+            storage.download_from_uri(self.uri, self.path)
+            if not _complete(self.path):
+                raise RuntimeError(
+                    f"downloaded checkpoint from {self.uri} is incomplete")
+
     def __reduce__(self):
-        return (Checkpoint, (self.path,))
+        return (Checkpoint, (self.path, self.uri))
 
     def __repr__(self):
         return f"Checkpoint({self.path})"
 
 
+def _to_host(leaf: Any) -> Any:
+    """Host (numpy) form of a host-local array leaf for pickling."""
+    try:
+        import jax
+
+        if isinstance(leaf, jax.Array):
+            import numpy as np
+
+            return np.asarray(leaf)
+    except Exception:
+        pass
+    return leaf
+
+
+def _abstract(leaf: Any):
+    """Abstract (shape/dtype/sharding) form of a target leaf for orbax."""
+    import jax
+
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        return leaf
+    if not hasattr(leaf, "shape"):  # python scalar target (int/float/bool)
+        import numpy as np
+
+        a = np.asarray(leaf)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+    sharding = getattr(leaf, "sharding", None)
+    return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sharding)
+
+
+def _ckpt_index(name: str) -> Optional[int]:
+    """Index of a checkpoint_NNNNNN dir name; None for anything else
+    (crashed download temps, markers, user files)."""
+    if not name.startswith("checkpoint_"):
+        return None
+    try:
+        return int(name.split("_")[-1])
+    except ValueError:
+        return None
+
+
+def _saved_procs(path: str) -> Optional[int]:
+    """process_count recorded at save time; None when unreadable."""
+    try:
+        with open(os.path.join(path, _AUX_FILE), "rb") as f:
+            return pickle.load(f).get("procs", 1)
+    except Exception:
+        # legacy pickle / payload checkpoints are single-process by nature
+        if (os.path.exists(os.path.join(path, "state.pkl"))
+                or os.path.exists(os.path.join(path, "payload.pkl"))):
+            return 1
+        return None
+
+
+def _complete(path: str) -> bool:
+    """True when `path` holds a complete checkpoint: legacy/payload formats,
+    or aux.pkl plus (when array leaves exist) a committed orbax dir —
+    orbax's own tmp-dir+rename makes the arrays dir presence equivalent to
+    a committed save, so a crash mid-write never passes this check."""
+    if not os.path.isdir(path):
+        return False
+    if (os.path.exists(os.path.join(path, "state.pkl"))
+            or os.path.exists(os.path.join(path, "payload.pkl"))):
+        return True
+    aux_path = os.path.join(path, _AUX_FILE)
+    if not os.path.exists(aux_path):
+        return False
+    try:
+        with open(aux_path, "rb") as f:
+            aux = pickle.load(f)
+    except Exception:
+        return False
+    has_arrays = aux["n"] > len(aux["others"])
+    return (not has_arrays
+            or os.path.isdir(os.path.join(path, _ARRAYS_SUBDIR)))
+
+
 class CheckpointManager:
     """Keeps the last N checkpoints in a run directory (ref:
-    CheckpointConfig.num_to_keep + air checkpoint manager)."""
+    CheckpointConfig.num_to_keep + air checkpoint manager).
+
+    run_dir may be a local path or a storage URI (file:// memory:// gs://
+    s3://). With a URI, checkpoints are written to a deterministic local
+    staging dir and mirrored to the URI on register(); latest() prefers
+    local staging but falls back to downloading from the URI — so a
+    restarted (or migrated) job resumes from cloud storage with no local
+    state. ref: air _internal/remote_storage.py + SURVEY §5.4.
+    """
 
     def __init__(self, run_dir: str, num_to_keep: Optional[int] = None):
+        self.uri: Optional[str] = None
+        if storage.is_uri(run_dir):
+            self.uri = run_dir.rstrip("/")
+            run_dir = storage.local_staging_dir(self.uri)
         self.run_dir = run_dir
         self.num_to_keep = num_to_keep
         os.makedirs(run_dir, exist_ok=True)
         self._index = 0
         self._kept: list[str] = []
+        self._mirror_q: Optional[Any] = None  # lazy upload-worker queue
+        #: background mirror failures (persistence problems surfaced to
+        #: callers that check; each is also logged when it happens)
+        self.mirror_errors: List[str] = []
         self._load_existing()
 
     def _load_existing(self):
-        existing = sorted(d for d in os.listdir(self.run_dir)
-                          if d.startswith("checkpoint_"))
+        names = {d for d in os.listdir(self.run_dir)
+                 if _ckpt_index(d) is not None}
+        if self.uri:
+            names |= {d for d in storage.list_at_uri(self.uri)
+                      if _ckpt_index(d) is not None}
+        existing = sorted(names)
         self._kept = [os.path.join(self.run_dir, d) for d in existing]
         if existing:
-            self._index = int(existing[-1].split("_")[-1]) + 1
+            self._index = _ckpt_index(existing[-1]) + 1
 
-    def new_dir(self) -> str:
+    def new_dir(self, index: Optional[int] = None) -> str:
+        """Next checkpoint dir. Pass `index` to pin a rank-agreed slot (a
+        multi-host gang broadcasts rank 0's index and every rank MUST use
+        exactly that slot — orbax's multihost barriers key on the
+        directory path, so any rank diverging hangs the gang)."""
+        if index is not None:
+            self._index = index
         path = os.path.join(self.run_dir, f"checkpoint_{self._index:06d}")
         self._index += 1
         return path
 
-    def register(self, path: str):
+    def register(self, path: str, primary: bool = True,
+                 sync: bool = True):
+        """Track a saved checkpoint; mirror it to the URI when set.
+
+        In a multi-host gang every rank registers (each uploads the orbax
+        shard files its process wrote — the remote dir is the merge), but
+        only the primary writes the completion marker and performs remote
+        eviction. The caller must barrier between non-primary and primary
+        registration so the marker lands after all shards
+        (session.report does). With sync=False (single-process mode) the
+        upload+marker+remote-evict run on a background thread in FIFO
+        order so the train loop isn't stalled for the transfer; call
+        flush() to join (a checkpoint whose upload hasn't finished is
+        protected by the marker gate in latest())."""
+        evict: List[str] = []
         self._kept.append(path)
         if self.num_to_keep is not None:
             while len(self._kept) > self.num_to_keep:
-                old = self._kept.pop(0)
+                evict.append(self._kept.pop(0))
+
+        def evict_local():
+            for old in evict:
                 shutil.rmtree(old, ignore_errors=True)
+
+        def mirror():
+            # eviction rides the mirror job so an evicted checkpoint's own
+            # queued upload (FIFO-earlier) always finishes first
+            Checkpoint(path).to_uri(
+                storage.join_uri(self.uri, os.path.basename(path)),
+                write_marker=primary)
+            if primary:
+                for old in evict:
+                    storage.delete_at_uri(
+                        storage.join_uri(self.uri, os.path.basename(old)))
+            evict_local()
+
+        if self.uri:
+            if sync:
+                mirror()
+            else:
+                self._enqueue_mirror(mirror)
+        else:
+            evict_local()
+
+    def _enqueue_mirror(self, job) -> None:
+        if self._mirror_q is None:
+            import queue
+
+            self._mirror_q = queue.Queue()
+
+            def worker():
+                while True:
+                    j = self._mirror_q.get()
+                    try:
+                        if j is not None:
+                            j()
+                    except Exception as e:
+                        # marker gate keeps the partial upload unrestorable,
+                        # but the operator must hear persistence is failing
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "background checkpoint mirror failed: %s", e)
+                        self.mirror_errors.append(str(e))
+                    finally:
+                        self._mirror_q.task_done()
+
+            import threading
+
+            threading.Thread(target=worker, daemon=True,
+                             name="ckpt-mirror").start()
+        self._mirror_q.put(job)
+
+    def flush(self) -> None:
+        """Join all queued background mirrors."""
+        if self._mirror_q is not None:
+            self._mirror_q.join()
 
     def latest(self) -> Optional[Checkpoint]:
         for path in reversed(self._kept):
-            ck = Checkpoint(path)
-            if ck.exists():
-                return ck
+            remote = (storage.join_uri(self.uri, os.path.basename(path))
+                      if self.uri else None)
+            if _complete(path):
+                # a marker-less mirror (crash mid-upload) must never be
+                # downloaded by another node — heal it from the local
+                # copy, but ONLY if that copy holds every shard (i.e. a
+                # single-process save; one host of a collective save
+                # can't certify the other hosts' shards)
+                if remote and not self._marked(remote):
+                    if _saved_procs(path) == 1:
+                        try:
+                            Checkpoint(path).to_uri(remote)
+                        except Exception:
+                            remote = None
+                    else:
+                        remote = None
+                return Checkpoint(path, uri=remote)
+            # Local copy absent or partial (crash mid-save/mid-download):
+            # hand back a lazy remote-backed checkpoint — but only when
+            # the upload finished (marker present). No data moves here;
+            # load_state downloads on first read. Transient storage errors
+            # skip to the next-older candidate instead of aborting the
+            # caller's recovery loop.
+            if remote and self._marked(remote):
+                return Checkpoint(path, uri=remote)
         return None
+
+    @staticmethod
+    def _marked(remote: str) -> bool:
+        try:
+            return storage.exists_at_uri(
+                storage.join_uri(remote, _REMOTE_MARKER))
+        except Exception:
+            # transient storage error: treat as unusable, caller moves on
+            # to an older candidate instead of aborting its recovery loop
+            return False

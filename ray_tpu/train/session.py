@@ -30,8 +30,12 @@ class TrainContext:
         self.reports: List[dict] = []
         self.report_lock = threading.Lock()
         self.latest_checkpoint: Optional[Checkpoint] = checkpoint
-        self.ckpt_mgr = (CheckpointManager(run_dir, num_to_keep)
-                         if world_rank == 0 else None)
+        # Every rank gets a manager over the same run_dir so all ranks
+        # resolve the same checkpoint_NNNNNN paths; only rank 0 registers
+        # (uploads/evicts). In a multi-host jax runtime the orbax save is
+        # collective — every process must enter from_state (each writes its
+        # addressable shards), so non-zero ranks need the path too.
+        self.ckpt_mgr = CheckpointManager(run_dir, num_to_keep)
         self.finished = False
         self._mesh = None
 
@@ -64,18 +68,64 @@ def get_config() -> dict:
 
 def report(metrics: Dict[str, Any], *, state: Any = None) -> None:
     """Report metrics (streamed to the trainer) and optionally checkpoint a
-    jax pytree `state` (rank 0 writes; ref: session.report:429)."""
+    jax pytree `state` (ref: session.report:429).
+
+    Checkpoint contract (same as the reference's distributed checkpointing:
+    every train worker must call `train.report` with a checkpoint): when the
+    workers form one multi-host jax runtime, EVERY rank must pass `state` on
+    the same reports — the orbax save and its barriers are collective, and a
+    rank that skips them hangs the gang. Single-process workers: rank 0's
+    state is saved, other ranks' is ignored."""
     ctx = get_context()
     entry = dict(metrics)
     entry["_ts"] = time.time()
     entry["_rank"] = ctx.world_rank
     ckpt_path = None
-    if state is not None and ctx.ckpt_mgr is not None:
-        path = ctx.ckpt_mgr.new_dir()
-        ck = Checkpoint.from_state(state, path)
-        ctx.ckpt_mgr.register(path)
-        ctx.latest_checkpoint = ck
-        ckpt_path = ck.path
+    if state is not None:
+        import jax
+
+        # Collective save: when the workers form one multi-host jax
+        # runtime, EVERY process must call from_state (orbax writes each
+        # process's addressable shards + a sync barrier). With independent
+        # single-process workers (process_count==1), rank 0 saves alone.
+        collective = jax.process_count() > 1
+        if ctx.world_rank == 0 or collective:
+            if collective:
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                # all ranks write into rank 0's checkpoint slot — a
+                # replacement rank with a fresh staging dir may disagree
+                # on the next index
+                idx = int(multihost_utils.broadcast_one_to_all(
+                    np.int32(ctx.ckpt_mgr._index)))
+                path = ctx.ckpt_mgr.new_dir(index=idx)
+            else:
+                path = ctx.ckpt_mgr.new_dir()
+            ck = Checkpoint.from_state(state, path)
+            if ctx.world_rank != 0 and collective:
+                # mirror this rank's shard files + evict per num_to_keep
+                # on this host; no marker, no remote eviction; synchronous
+                # so the barrier below really covers the upload
+                ctx.ckpt_mgr.register(path, primary=False)
+            if collective:
+                # the primary's completion marker must land after every
+                # rank's shard upload
+                multihost_utils.sync_global_devices("ray_tpu_ckpt_mirror")
+            if ctx.world_rank == 0:
+                # single-process mode mirrors on a background thread so
+                # the train loop isn't stalled for the upload
+                ctx.ckpt_mgr.register(path, primary=True,
+                                      sync=collective)
+                ctx.latest_checkpoint = ck
+                ckpt_path = ck.path
+                if ctx.ckpt_mgr.uri:
+                    import os as _os
+
+                    from ray_tpu.train import storage as _storage
+
+                    entry["_checkpoint_uri"] = _storage.join_uri(
+                        ctx.ckpt_mgr.uri, _os.path.basename(path))
     if ckpt_path:
         entry["_checkpoint"] = ckpt_path
     with ctx.report_lock:

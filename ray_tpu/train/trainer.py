@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.core.status import ActorDiedError, ActorUnavailableError, TaskError
+from ray_tpu.train import storage
 from ray_tpu.train.backend import TorchBackend
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig, ScalingConfig
@@ -61,6 +62,10 @@ class JaxTrainer:
         base = self.run_config.storage_path or os.path.expanduser(
             "~/ray_tpu_results")
         name = self.run_config.name or f"run_{int(time.time())}"
+        if storage.is_uri(base):
+            # remote run dir: CheckpointManager stages locally and mirrors
+            # to the URI (ref: air RunConfig.storage_path cloud URIs)
+            return storage.join_uri(base, name)
         path = os.path.join(base, name)
         os.makedirs(path, exist_ok=True)
         return path
@@ -79,8 +84,9 @@ class JaxTrainer:
                     ray_tpu.exceptions.NodeDiedError) as e:
                 attempt += 1
                 # resume from the newest checkpoint any attempt produced
-                ck = Checkpoint(result.metrics.get("_checkpoint", "")) \
-                    if result.metrics.get("_checkpoint") else checkpoint
+                ck = (Checkpoint(result.metrics["_checkpoint"],
+                                 uri=result.metrics.get("_checkpoint_uri"))
+                      if result.metrics.get("_checkpoint") else checkpoint)
                 checkpoint = _latest_checkpoint(run_dir) or ck
                 if max_failures >= 0 and attempt > max_failures:
                     result.error = f"worker group failed: {e}"
@@ -136,7 +142,9 @@ class JaxTrainer:
                     result.error = str(e)
                     break
             if result.metrics.get("_checkpoint"):
-                result.checkpoint = Checkpoint(result.metrics["_checkpoint"])
+                result.checkpoint = Checkpoint(
+                    result.metrics["_checkpoint"],
+                    uri=result.metrics.get("_checkpoint_uri"))
             else:
                 result.checkpoint = _latest_checkpoint(run_dir)
             return result

@@ -67,6 +67,11 @@ class TrainWorker:
         finally:
             if self.ctx is not None:
                 self.ctx.finished = True
+                if self.ctx.ckpt_mgr is not None:
+                    try:  # commit pending background checkpoint mirrors
+                        self.ctx.ckpt_mgr.flush()
+                    except Exception:
+                        pass
             try:
                 self.backend.on_worker_shutdown()
             except Exception:
