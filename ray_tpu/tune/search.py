@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import itertools
 import random
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 class GridSearch:
@@ -449,7 +449,91 @@ def _gated_searcher(name: str, package: str):
     return _Gated
 
 
-OptunaSearch = _gated_searcher("OptunaSearch", "optuna")
+class OptunaSearch(Searcher):
+    """Adapter over optuna's ask/tell Study API (ref:
+    python/ray/tune/search/optuna/optuna_search.py). Our samplers map onto
+    optuna distributions: Uniform→suggest_float, LogUniform→log float,
+    RandInt→suggest_int, Choice/GridSearch→suggest_categorical. The image
+    does not ship optuna; the class constructs against any module exposing
+    create_study/ask/tell (exercised in CI via a mock), and against the
+    real package when installed in a driver env."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max",
+                 sampler=None, seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch needs the 'optuna' package, which is not in "
+                "the TPU image. Install it in your driver environment, or "
+                "use the in-image TPESearcher / BayesOptSearch.") from e
+        self._optuna = optuna
+        self.param_space = space or {}
+        self._sampler = sampler
+        self._seed = seed
+        self._study = None
+        self._trials: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, param_space):
+        if self.metric is None:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+        # a constructor-provided space wins over an empty Tuner space
+        # (ref: the reference adapter refuses to overwrite a set space)
+        if param_space or not self.param_space:
+            self.param_space = param_space
+
+    def _ensure_study(self):
+        if self.metric is None:
+            raise ValueError(
+                "OptunaSearch needs a metric (constructor or "
+                "TuneConfig.metric) — without one every completed trial "
+                "would be reported to optuna as failed")
+        if self._study is None:
+            optuna = self._optuna
+            sampler = self._sampler
+            if sampler is None and self._seed is not None:
+                sampler = optuna.samplers.TPESampler(seed=self._seed)
+            self._study = optuna.create_study(
+                direction="maximize" if self.mode == "max" else "minimize",
+                sampler=sampler)
+        return self._study
+
+    def suggest(self, trial_id):
+        study = self._ensure_study()
+        t = study.ask()
+        import math
+
+        cfg = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, LogUniform):
+                cfg[k] = t.suggest_float(k, math.exp(v.lo), math.exp(v.hi),
+                                         log=True)
+            elif isinstance(v, Uniform):
+                cfg[k] = t.suggest_float(k, v.lo, v.hi)
+            elif isinstance(v, RandInt):
+                cfg[k] = t.suggest_int(k, v.lo, v.hi - 1)
+            elif isinstance(v, (Choice, GridSearch)):
+                cfg[k] = t.suggest_categorical(k, v.values)
+            else:
+                cfg[k] = v
+        self._trials[trial_id] = t
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        t = self._trials.pop(trial_id, None)
+        if t is None:
+            return
+        study = self._ensure_study()
+        if error or not result or self.metric not in result:
+            study.tell(t, state=self._optuna.trial.TrialState.FAIL)
+        else:
+            study.tell(t, float(result[self.metric]))
+
+
 HyperOptSearch = _gated_searcher("HyperOptSearch", "hyperopt")
 TuneBOHB = _gated_searcher("TuneBOHB", "hpbandster")
 AxSearch = _gated_searcher("AxSearch", "ax-platform")

@@ -211,6 +211,173 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config
+        #: experiment ledger: trial_id -> {config, status, metrics, error,
+        #: stopped_early, has_ckpt}; mirrored to experiment_state.json after
+        #: every transition so a killed driver can be restored
+        self._exp: Dict[str, dict] = {}
+        self._restored = False
+
+    # ---- experiment-state persistence (ref: tune/tuner.py:180 restore +
+    #      tune/execution/experiment_state.py snapshots) ------------------
+
+    @classmethod
+    def restore(cls, path: str, trainable: Optional[Callable] = None,
+                *, resume_errored: bool = False) -> "Tuner":
+        """Recover a sweep whose driver died (ref: Tuner.restore,
+        python/ray/tune/tuner.py:180). `path` is the run dir
+        (storage_path/name). Completed trials keep their results;
+        queued/running trials are re-launched, running ones from their
+        last persisted checkpoint. Pass `trainable` when the original one
+        doesn't pickle; `resume_errored` also re-runs failed trials."""
+        import pickle
+
+        with open(os.path.join(path, "tuner.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        if trainable is None:
+            try:
+                import cloudpickle
+
+                with open(os.path.join(path, "trainable.pkl"), "rb") as f:
+                    trainable = cloudpickle.load(f)
+            except Exception as e:
+                raise ValueError(
+                    "the original trainable could not be recovered from "
+                    f"{path} ({e}); pass Tuner.restore(path, "
+                    "trainable=...)") from e
+        tuner = cls(trainable, param_space=meta["param_space"] or {},
+                    tune_config=meta["tune_config"] or TuneConfig(),
+                    run_config=meta["run_config"])
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            tuner._exp = json.load(f)["trials"]
+        # configs round-trip through pickle, not JSON — json.dump(default=
+        # str) stringifies non-JSON values (np dtypes, tuples) and a
+        # restored trial must see exactly what the original saw
+        cfgs = os.path.join(path, "configs.pkl")
+        if os.path.exists(cfgs):
+            with open(cfgs, "rb") as f:
+                for tid, cfg in pickle.load(f).items():
+                    if tid in tuner._exp:
+                        tuner._exp[tid]["config"] = cfg
+        ctrl = os.path.join(path, "controller.pkl")
+        if os.path.exists(ctrl):  # searcher/scheduler mid-sweep state
+            try:
+                with open(ctrl, "rb") as f:
+                    st = pickle.load(f)
+                if st.get("searcher") is not None:
+                    tuner.tune_config.search_alg = st["searcher"]
+                if st.get("scheduler") is not None:
+                    tuner.tune_config.scheduler = st["scheduler"]
+            except Exception:
+                pass  # fall back to fresh searcher over remaining trials
+        if resume_errored:
+            for rec in tuner._exp.values():
+                if rec["status"] == "done" and rec.get("error"):
+                    rec.update(status="queued", error=None, metrics={})
+        tuner._restored = True
+        # restore() must point at the same run dir
+        if tuner.run_config is None or not getattr(
+                tuner.run_config, "storage_path", None):
+            from ray_tpu.train.config import RunConfig
+
+            tuner.run_config = RunConfig(
+                name=os.path.basename(path.rstrip("/")),
+                storage_path=os.path.dirname(path.rstrip("/")))
+        return tuner
+
+    def _snapshot(self, run_dir: Optional[str]) -> None:
+        import pickle
+
+        if not run_dir:
+            return
+        tmp = os.path.join(run_dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"trials": self._exp}, f, indent=2, default=str)
+        os.replace(tmp, os.path.join(run_dir, "experiment_state.json"))
+        # exact (typed) configs ride a pickle sidecar; the json stays
+        # human-readable for status polling
+        tmp2 = os.path.join(run_dir, ".configs.tmp")
+        try:
+            with open(tmp2, "wb") as f:
+                pickle.dump({tid: rec["config"]
+                             for tid, rec in self._exp.items()}, f)
+            os.replace(tmp2, os.path.join(run_dir, "configs.pkl"))
+        except Exception:
+            pass  # unpicklable config value: restore falls back to json
+
+    def _save_meta(self, run_dir: Optional[str]) -> None:
+        import pickle
+
+        if not run_dir:
+            return
+        try:
+            # by-value for __main__/script functions, same as task export
+            from ray_tpu.core.runtime import _dumps_function
+
+            blob = _dumps_function(self.trainable)
+            with open(os.path.join(run_dir, "trainable.pkl"), "wb") as f:
+                f.write(blob)
+        except Exception:
+            pass  # restore() will require an explicit trainable
+        meta = {}
+        for key, val in (("param_space", self.param_space),
+                         ("tune_config", self.tune_config),
+                         ("run_config", self.run_config)):
+            try:
+                pickle.dumps(val)
+                meta[key] = val
+            except Exception:
+                # unpicklable scheduler/callback/etc: the run proceeds,
+                # restore degrades to defaults for this piece
+                meta[key] = None
+        with open(os.path.join(run_dir, "tuner.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+
+    def _save_controller(self, run_dir: Optional[str], searcher,
+                         scheduler) -> None:
+        import pickle
+
+        if not run_dir:
+            return
+        try:
+            blob = pickle.dumps({"searcher": searcher,
+                                 "scheduler": scheduler})
+        except Exception:
+            return  # unpicklable searcher: restore falls back to fresh
+        tmp = os.path.join(run_dir, ".controller.tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(run_dir, "controller.pkl"))
+
+    def _ckpt_file(self, run_dir: str, tid: str) -> str:
+        return os.path.join(run_dir, f"ckpt_{tid}.pkl")
+
+    def _persist_trial_ckpt(self, run_dir: Optional[str], tid: str,
+                            payload: Any) -> None:
+        import pickle
+
+        if not run_dir:
+            return
+        tmp = self._ckpt_file(run_dir, tid) + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, self._ckpt_file(run_dir, tid))
+            if not self._exp[tid].get("has_ckpt"):
+                self._exp[tid]["has_ckpt"] = True
+                self._snapshot(run_dir)
+        except Exception:
+            pass  # unpicklable payload: restore starts the trial fresh
+
+    def _load_trial_ckpt(self, run_dir: Optional[str], tid: str) -> Any:
+        import pickle
+
+        if not run_dir:
+            return None
+        try:
+            with open(self._ckpt_file(run_dir, tid), "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            return None
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
@@ -218,22 +385,50 @@ class Tuner:
         if getattr(scheduler, "metric", None) is None and hasattr(scheduler, "metric"):
             scheduler.metric = tc.metric
         searcher = tc.search_alg
+        run_dir = self._run_dir()
+        if run_dir:
+            os.makedirs(run_dir, exist_ok=True)
+            self._save_meta(run_dir)
+        results: Dict[str, TrialResult] = {}
         if searcher is not None:
+            # also on restore: the searcher may be a fresh instance (no
+            # controller.pkl yet) that never saw the space; adapters keep
+            # their own space when the incoming one is empty
             searcher.set_search_properties(tc.metric, tc.mode,
                                            self.param_space)
             total = tc.num_samples
             pending: List = []  # searcher asked on demand
         else:
-            variants = generate_variants(self.param_space, tc.num_samples,
-                                         tc.seed)
-            total = len(variants)
-            pending = [(f"trial_{i:05d}", cfg)
-                       for i, cfg in enumerate(variants)]
+            if not self._restored:
+                variants = generate_variants(self.param_space,
+                                             tc.num_samples, tc.seed)
+                self._exp = {f"trial_{i:05d}": {"config": cfg,
+                                                "status": "queued",
+                                                "metrics": {}, "error": None,
+                                                "stopped_early": False,
+                                                "has_ckpt": False}
+                             for i, cfg in enumerate(variants)}
+                self._snapshot(run_dir)
+            total = len(self._exp)
+            pending = [(tid, rec["config"])
+                       for tid, rec in sorted(self._exp.items())
+                       if rec["status"] != "done"]
+        if self._restored:
+            # completed trials keep their recorded results (never re-run);
+            # queued/running ones re-enter the queue, running-with-ckpt
+            # resume from their persisted checkpoint payload
+            for tid, rec in sorted(self._exp.items()):
+                if rec["status"] == "done":
+                    results[tid] = TrialResult(
+                        tid, rec["config"], metrics=rec["metrics"],
+                        error=rec["error"],
+                        stopped_early=rec.get("stopped_early", False))
+                elif searcher is not None:
+                    pending.append((tid, rec["config"]))
         max_conc = tc.max_concurrent_trials or max(1, total)
         # with an explicit queue the launch budget is the queue itself
-        launched = 0 if searcher is not None else total
+        launched = len(self._exp) if searcher is not None else total
         running: Dict[str, dict] = {}
-        results: Dict[str, TrialResult] = {}
         # logger callbacks (ref: RunConfig.callbacks → tune/logger/*)
         callbacks = list(getattr(self.run_config, "callbacks", None) or [])
         if callbacks:
@@ -250,6 +445,17 @@ class Tuner:
                 started.add(trial_id)
                 for cb in callbacks:
                     cb.on_trial_start(trial_id, cfg)
+            rec = self._exp.setdefault(
+                trial_id, {"config": cfg, "status": "queued", "metrics": {},
+                           "error": None, "stopped_early": False,
+                           "has_ckpt": False})
+            if start_checkpoint is None and rec.get("has_ckpt"):
+                # driver restored mid-sweep: trial resumes from its last
+                # persisted checkpoint payload
+                start_checkpoint = self._load_trial_ckpt(run_dir, trial_id)
+            rec["status"] = "running"
+            rec["config"] = cfg
+            self._snapshot(run_dir)
             actor = _TrialActor.options(
                 resources=dict(tc.resources_per_trial),
                 max_concurrency=2).remote(trial_id, cfg, start_checkpoint)
@@ -264,11 +470,16 @@ class Tuner:
 
         def finish(tid: str, res: TrialResult, error: bool):
             results[tid] = res
+            self._exp[tid].update(status="done", metrics=res.metrics,
+                                  error=res.error,
+                                  stopped_early=res.stopped_early)
+            self._snapshot(run_dir)
             for cb in callbacks:
                 cb.on_trial_complete(tid, res)
             if searcher is not None:
                 searcher.on_trial_complete(
                     tid, {**res.metrics, "config": res.config}, error=error)
+            self._save_controller(run_dir, searcher, scheduler)
 
         # ---- controller loop (ref: tune_controller.step:267) ----
         while pending or running or launched < total:
@@ -305,6 +516,8 @@ class Tuner:
                 if "checkpoint" in poll:
                     st["checkpoint"] = poll["checkpoint"]
                     st["ckpt_seen"] = poll["ckpt_version"]
+                    self._persist_trial_ckpt(run_dir, tid,
+                                             poll["checkpoint"])
                 res = st["result"]
                 exploit = None
                 for r in poll["reports"]:
@@ -348,7 +561,6 @@ class Tuner:
         ordered = [results[tid] for tid in sorted(results)]
         for cb in callbacks:
             cb.on_experiment_end(ordered)
-        self._save_experiment_state(ordered)
         return ResultGrid(ordered, tc.metric, tc.mode)
 
     def _run_dir(self) -> Optional[str]:
@@ -358,14 +570,3 @@ class Tuner:
             if base and name:
                 return os.path.join(base, name)
         return None
-
-    def _save_experiment_state(self, results: List[TrialResult]):
-        run_dir = self._run_dir()
-        if run_dir is None:
-            return
-        os.makedirs(run_dir, exist_ok=True)
-        state = [{"trial_id": r.trial_id, "config": r.config,
-                  "metrics": r.metrics, "error": r.error,
-                  "stopped_early": r.stopped_early} for r in results]
-        with open(os.path.join(run_dir, "experiment_state.json"), "w") as f:
-            json.dump(state, f, indent=2, default=str)
