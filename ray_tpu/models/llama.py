@@ -48,6 +48,21 @@ class LlamaConfig:
     # the attention is banded). Unsupported with ring/ulysses.
     sliding_window: Any = None
     remat: bool = True               # jax.checkpoint each layer (HBM savings)
+    # What the per-layer checkpoint may keep: "none" (full recompute,
+    # maximum HBM savings) or "dots" (save matmul outputs, recompute only
+    # elementwise/norms — jax.checkpoint_policies
+    # .dots_with_no_batch_dims_saveable). "dots" trades a little HBM for
+    # skipping the matmul recompute in the backward.
+    remat_policy: str = "none"
+    # Concatenate wq/wk/wv (and w_gate/w_up) into single wider matmuls at
+    # apply time. Same params/checkpoints; at small d_model the wider N
+    # dimension keeps the MXU tiles full.
+    fused_matmuls: bool = False
+    # Emit [B, S, vocab] logits in f32 (safe default) or keep them in the
+    # compute dtype. With the logsumexp-form CE below, bf16 logits with
+    # f32-accumulated reductions (XLA fuses the upcast into the reduce)
+    # halve the largest activation's HBM traffic in both directions.
+    f32_logits: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -134,6 +149,14 @@ def num_params(cfg: LlamaConfig) -> int:
 # --- building blocks --------------------------------------------------------
 
 
+def _checkpoint(body, cfg: "LlamaConfig"):
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(body)
+
+
 def rms_norm(x, scale, eps):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
@@ -215,9 +238,20 @@ def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None, collect_kv=False):
     dt = cfg.dtype
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, HD)
-    k = (h @ lp["wk"].astype(dt)).reshape(B, S, KV, HD)
-    v = (h @ lp["wv"].astype(dt)).reshape(B, S, KV, HD)
+    if cfg.fused_matmuls:
+        # One [D, (H+2KV)*HD] matmul instead of three: at small d_model the
+        # MXU is launch/tile-bound, so widening N raises utilization.
+        wqkv = jnp.concatenate([lp["wq"], lp["wk"], lp["wv"]],
+                               axis=-1).astype(dt)
+        qkv = h @ wqkv
+        q, k, v = jnp.split(qkv, [H * HD, (H + KV) * HD], axis=-1)
+        q = q.reshape(B, S, H, HD)
+        k = k.reshape(B, S, KV, HD)
+        v = v.reshape(B, S, KV, HD)
+    else:
+        q = (h @ lp["wq"].astype(dt)).reshape(B, S, H, HD)
+        k = (h @ lp["wk"].astype(dt)).reshape(B, S, KV, HD)
+        v = (h @ lp["wv"].astype(dt)).reshape(B, S, KV, HD)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -238,8 +272,15 @@ def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None, collect_kv=False):
     x = x + attn @ lp["wo"].astype(dt)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
+    if cfg.fused_matmuls:
+        w_gu = jnp.concatenate([lp["w_gate"], lp["w_up"]],
+                               axis=-1).astype(dt)
+        gu = h @ w_gu
+        gate, up = jnp.split(gu, 2, axis=-1)
+        gate = jax.nn.silu(gate)
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
     x = x + (gate * up) @ lp["w_down"].astype(dt)
     if collect_kv:
         return x, (k, v)
@@ -266,11 +307,11 @@ def forward(params, tokens, cfg: LlamaConfig, pos_offset=0):
         return y, None
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        body = _checkpoint(body, cfg)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"].astype(dt)
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32) if cfg.f32_logits else logits
 
 
 def forward_sp(params, tokens, cfg: LlamaConfig, mesh):
@@ -318,7 +359,7 @@ def forward_pp(params, tokens, cfg: LlamaConfig, mesh, num_microbatches=None):
             return y, None
 
         if cfg.remat:
-            body = jax.checkpoint(body)
+            body = _checkpoint(body, cfg)
         x, _ = jax.lax.scan(body, x, stage_layers)
         return x
 
@@ -327,7 +368,7 @@ def forward_pp(params, tokens, cfg: LlamaConfig, mesh, num_microbatches=None):
     x = trunk(stacked, x)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"].astype(dt)
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32) if cfg.f32_logits else logits
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
@@ -348,8 +389,13 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
         logits = forward_pp(params, inputs, cfg, mesh)
     else:
         logits = forward(params, inputs, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # nll = logsumexp(logits) - logit[target]: same value/gradient as
+    # log_softmax + gather but never materializes the [B, S, V] log_softmax
+    # tensor (1 GB f32 at B=8 S=1024 V=32k — pure HBM traffic).
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logits, targets[..., None],
+                             axis=-1)[..., 0].astype(jnp.float32)
+    nll = lse - ll
     if mask is None:
         return nll.mean()
     mask = mask.astype(nll.dtype)

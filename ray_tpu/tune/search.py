@@ -475,6 +475,28 @@ class OptunaSearch(Searcher):
         self._seed = seed
         self._study = None
         self._trials: Dict[str, Any] = {}
+        self._cfgs: Dict[str, dict] = {}     # trial_id -> suggested cfg
+        #: completed observations (cfg, value, failed) — the picklable
+        #: record of what the study has seen; replayed into a fresh study
+        #: after Tuner.restore unpickles this searcher
+        self._history: list = []
+
+    # The live optuna module/Study/Trial objects don't pickle, which would
+    # make Tuner's controller.pkl snapshot silently fail for this adapter.
+    # Pickle the observation history instead and replay it on restore.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        for k in ("_optuna", "_study", "_trials"):
+            state.pop(k, None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        import optuna
+
+        self._optuna = optuna
+        self._study = None
+        self._trials = {}
 
     def set_search_properties(self, metric, mode, param_space):
         if self.metric is None:
@@ -500,7 +522,37 @@ class OptunaSearch(Searcher):
             self._study = optuna.create_study(
                 direction="maximize" if self.mode == "max" else "minimize",
                 sampler=sampler)
+            for cfg, value, failed in self._history:
+                if failed or value is None:
+                    continue
+                try:
+                    self._study.add_trial(optuna.trial.create_trial(
+                        params={k: v for k, v in cfg.items()
+                                if k in self._distributions()},
+                        distributions=self._distributions(), value=value))
+                except Exception:
+                    # replay is best-effort: a study that forgot history
+                    # still suggests valid configs
+                    break
         return self._study
+
+    def _distributions(self):
+        import math
+
+        optuna = self._optuna
+        dist = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, LogUniform):
+                dist[k] = optuna.distributions.FloatDistribution(
+                    math.exp(v.lo), math.exp(v.hi), log=True)
+            elif isinstance(v, Uniform):
+                dist[k] = optuna.distributions.FloatDistribution(v.lo, v.hi)
+            elif isinstance(v, RandInt):
+                dist[k] = optuna.distributions.IntDistribution(v.lo, v.hi - 1)
+            elif isinstance(v, (Choice, GridSearch)):
+                dist[k] = optuna.distributions.CategoricalDistribution(
+                    v.values)
+        return dist
 
     def suggest(self, trial_id):
         study = self._ensure_study()
@@ -521,17 +573,24 @@ class OptunaSearch(Searcher):
             else:
                 cfg[k] = v
         self._trials[trial_id] = t
+        self._cfgs[trial_id] = cfg
         return cfg
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         t = self._trials.pop(trial_id, None)
+        cfg = self._cfgs.pop(trial_id, None)
         if t is None:
             return
         study = self._ensure_study()
         if error or not result or self.metric not in result:
             study.tell(t, state=self._optuna.trial.TrialState.FAIL)
+            if cfg is not None:
+                self._history.append((cfg, None, True))
         else:
-            study.tell(t, float(result[self.metric]))
+            val = float(result[self.metric])
+            study.tell(t, val)
+            if cfg is not None:
+                self._history.append((cfg, val, False))
 
 
 HyperOptSearch = _gated_searcher("HyperOptSearch", "hyperopt")

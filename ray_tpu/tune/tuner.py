@@ -255,9 +255,15 @@ class Tuner:
         cfgs = os.path.join(path, "configs.pkl")
         if os.path.exists(cfgs):
             with open(cfgs, "rb") as f:
-                for tid, cfg in pickle.load(f).items():
-                    if tid in tuner._exp:
-                        tuner._exp[tid]["config"] = cfg
+                side = pickle.load(f)
+            if "configs" not in side:               # pre-r3 format
+                side = {"configs": side, "metrics": {}}
+            for tid, cfg in side["configs"].items():
+                if tid in tuner._exp:
+                    tuner._exp[tid]["config"] = cfg
+            for tid, mets in side["metrics"].items():
+                if tid in tuner._exp:
+                    tuner._exp[tid]["metrics"] = mets
         ctrl = os.path.join(path, "controller.pkl")
         if os.path.exists(ctrl):  # searcher/scheduler mid-sweep state
             try:
@@ -279,9 +285,12 @@ class Tuner:
                 tuner.run_config, "storage_path", None):
             from ray_tpu.train.config import RunConfig
 
+            # abspath: dirname of a bare relative run dir is "" which
+            # would silently disable all persistence for the restored run
+            apath = os.path.abspath(path.rstrip("/"))
             tuner.run_config = RunConfig(
-                name=os.path.basename(path.rstrip("/")),
-                storage_path=os.path.dirname(path.rstrip("/")))
+                name=os.path.basename(apath),
+                storage_path=os.path.dirname(apath))
         return tuner
 
     def _snapshot(self, run_dir: Optional[str]) -> None:
@@ -293,13 +302,18 @@ class Tuner:
         with open(tmp, "w") as f:
             json.dump({"trials": self._exp}, f, indent=2, default=str)
         os.replace(tmp, os.path.join(run_dir, "experiment_state.json"))
-        # exact (typed) configs ride a pickle sidecar; the json stays
+        # exact (typed) configs AND metrics ride a pickle sidecar — the
+        # json (default=str) stringifies np/jnp scalars, and a restored
+        # trial must see exactly what the original saw; the json stays
         # human-readable for status polling
         tmp2 = os.path.join(run_dir, ".configs.tmp")
         try:
             with open(tmp2, "wb") as f:
-                pickle.dump({tid: rec["config"]
-                             for tid, rec in self._exp.items()}, f)
+                pickle.dump({"configs": {tid: rec["config"]
+                                         for tid, rec in self._exp.items()},
+                             "metrics": {tid: rec["metrics"]
+                                         for tid, rec in self._exp.items()}},
+                            f)
             os.replace(tmp2, os.path.join(run_dir, "configs.pkl"))
         except Exception:
             pass  # unpicklable config value: restore falls back to json
@@ -453,6 +467,12 @@ class Tuner:
                 # driver restored mid-sweep: trial resumes from its last
                 # persisted checkpoint payload
                 start_checkpoint = self._load_trial_ckpt(run_dir, trial_id)
+            elif start_checkpoint is not None:
+                # PBT exploit hands this trial the SOURCE's checkpoint: it
+                # must land in ckpt_<tid>.pkl now, or a driver crash before
+                # the first post-exploit checkpoint restores the stale
+                # pre-exploit weights under the new config
+                self._persist_trial_ckpt(run_dir, trial_id, start_checkpoint)
             rec["status"] = "running"
             rec["config"] = cfg
             self._snapshot(run_dir)

@@ -195,3 +195,51 @@ def test_sliding_window_decode_and_guards():
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     with pytest.raises(ValueError, match="sliding_window"):
         llama.forward(params, toks, cfg)
+
+
+def test_fused_matmuls_parity():
+    """fused_matmuls concatenates wq/wk/wv and w_gate/w_up into wider
+    matmuls at apply time — same params, identical logits."""
+    params = llama.init_params(jax.random.PRNGKey(3), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0,
+                                CFG.vocab_size)
+    base = llama.forward(params, tokens, CFG)
+    fused = llama.forward(params, tokens, CFG.replace(fused_matmuls=True))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(fused),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_remat_policy_dots_grad_parity():
+    """remat_policy='dots' changes what the checkpoint saves, never the
+    math: loss and grads match full remat."""
+    cfg_full = CFG.replace(remat=True)
+    cfg_dots = CFG.replace(remat=True, remat_policy="dots")
+    params = llama.init_params(jax.random.PRNGKey(5), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 17), 0,
+                                CFG.vocab_size)
+    batch = {"tokens": tokens}
+    l1, g1 = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, batch, cfg_full))(params)
+    l2, g2 = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, batch, cfg_dots))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g1, g2)
+
+
+def test_bf16_logits_flag():
+    """f32_logits=False keeps logits in the compute dtype; loss still
+    computes its reductions in f32 and matches the f32-logits loss."""
+    cfg16 = CFG.replace(dtype=jnp.bfloat16, f32_logits=False)
+    cfg32 = CFG.replace(dtype=jnp.bfloat16, f32_logits=True)
+    params = llama.init_params(jax.random.PRNGKey(7), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 16), 0,
+                                CFG.vocab_size)
+    out16 = llama.forward(params, tokens, cfg16)
+    assert out16.dtype == jnp.bfloat16
+    out32 = llama.forward(params, tokens, cfg32)
+    assert out32.dtype == jnp.float32
+    l16 = llama.loss_fn(params, {"tokens": tokens}, cfg16)
+    l32 = llama.loss_fn(params, {"tokens": tokens}, cfg32)
+    assert l16.dtype == jnp.float32
+    np.testing.assert_allclose(float(l16), float(l32), rtol=2e-2)

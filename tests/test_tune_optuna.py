@@ -174,3 +174,26 @@ def test_optuna_gate_raises_without_package():
 
     with pytest.raises(ImportError, match="optuna"):
         OptunaSearch(metric="m")
+
+
+def test_optuna_adapter_pickles_with_history(monkeypatch):
+    """The adapter must survive pickle (Tuner's controller.pkl snapshot):
+    live module/study/trial handles are dropped, the observation history
+    rides along and replays into the fresh study on restore."""
+    import pickle
+
+    _install_mock_optuna(monkeypatch)
+    from ray_tpu import tune
+    from ray_tpu.tune.search import OptunaSearch
+
+    s = OptunaSearch(metric="score", mode="max", seed=11)
+    s.set_search_properties("score", "max", {"x": tune.uniform(0.0, 1.0)})
+    cfg = s.suggest("t0")
+    s.on_trial_complete("t0", {"score": 2.5, "config": cfg})
+
+    blob = pickle.dumps(s)          # would raise before the __getstate__ fix
+    s2 = pickle.loads(blob)
+    assert s2._history == [(cfg, 2.5, False)]
+    # the revived adapter keeps suggesting from the same space
+    cfg2 = s2.suggest("t1")
+    assert 0.0 <= cfg2["x"] <= 1.0

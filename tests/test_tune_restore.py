@@ -130,3 +130,61 @@ def test_tuner_restore_after_driver_kill(ray_start_regular, tmp_path):
 def test_tuner_restore_requires_run_dir_artifacts(tmp_path):
     with pytest.raises(FileNotFoundError):
         Tuner.restore(str(tmp_path / "nope"))
+
+
+def test_restored_metrics_keep_types(ray_start_regular, tmp_path):
+    """Completed-trial metrics must round-trip restore as numbers, not the
+    strings json default=str produces for np/jnp scalars (the pickle
+    sidecar carries the typed values)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.train.config import RunConfig
+
+    def trainable(config):
+        return {"score": np.float32(config["i"] * 1.5)}
+
+    from ray_tpu import tune
+
+    run_base = str(tmp_path / "runs")
+    tuner = Tuner(trainable,
+                  param_space={"i": tune.grid_search([7])},
+                  tune_config=TuneConfig(metric="score", mode="max"),
+                  run_config=RunConfig(name="typed", storage_path=run_base))
+    tuner.fit()
+
+    restored = Tuner.restore(os.path.join(run_base, "typed"),
+                             trainable=trainable)
+    grid = restored.fit()
+    score = grid.get_best_result().metrics["score"]
+    assert isinstance(score, (int, float, np.floating)), type(score)
+    assert float(score) == pytest.approx(10.5)
+
+
+def test_restore_bare_relative_path(ray_start_regular, tmp_path, monkeypatch):
+    """Tuner.restore('name') from inside the storage dir must still
+    persist (dirname of a bare path is '' — regression guard)."""
+    from ray_tpu.train.config import RunConfig
+
+    def trainable(config):
+        return {"score": 1.0}
+
+    run_base = str(tmp_path / "runs")
+    from ray_tpu import tune
+
+    tuner = Tuner(trainable, param_space={"i": tune.grid_search([0])},
+                  tune_config=TuneConfig(metric="score", mode="max"),
+                  run_config=RunConfig(name="rel", storage_path=run_base))
+    tuner.fit()
+    # simulate a run_config that did not survive pickling
+    meta_path = os.path.join(run_base, "rel", "tuner.pkl")
+    import pickle
+    with open(meta_path, "rb") as f:
+        meta = pickle.load(f)
+    meta["run_config"] = None
+    with open(meta_path, "wb") as f:
+        pickle.dump(meta, f)
+
+    monkeypatch.chdir(run_base)
+    restored = Tuner.restore("rel", trainable=trainable)
+    assert restored._run_dir() == os.path.join(run_base, "rel")
