@@ -154,6 +154,10 @@ def _checkpoint(body, cfg: "LlamaConfig"):
         return jax.checkpoint(
             body,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if cfg.remat_policy != "none":
+        raise ValueError(
+            f"remat_policy must be 'none' or 'dots', got "
+            f"{cfg.remat_policy!r}")
     return jax.checkpoint(body)
 
 

@@ -14,7 +14,8 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, probe_env_spec, rollout_result
+from ray_tpu.rl.core import (Algorithm, CPU_WORKER_ENV,
+                             probe_env_spec, rollout_result)
 from ray_tpu.rl.ppo import (RolloutWorker, compute_gae, init_policy,
                             policy_forward)
 
@@ -72,7 +73,7 @@ class A2CTrainer(Algorithm):
                                optax.rmsprop(cfg.lr, decay=0.99, eps=1e-5))
         self.opt_state = self.opt.init(self.params)
         self.workers = [
-            RolloutWorker.options(num_cpus=0.5).remote(
+            RolloutWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

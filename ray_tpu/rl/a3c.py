@@ -21,7 +21,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rl.a2c import make_a2c_loss
-from ray_tpu.rl.core import Algorithm, probe_env_spec, rollout_result
+from ray_tpu.rl.core import CPU_WORKER_ENV, Algorithm, probe_env_spec, rollout_result
 from ray_tpu.rl.ppo import RolloutWorker, compute_gae, init_policy
 
 
@@ -104,7 +104,7 @@ class A3CTrainer(Algorithm):
                     "vf_coeff": cfg.vf_coeff,
                     "entropy_coeff": cfg.entropy_coeff}
         self.workers = [
-            A3CWorker.remote(cfg.env, cfg.seed + i * 1000, cfg.env_config,
+            A3CWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.env, cfg.seed + i * 1000, cfg.env_config,
                              cfg_dict)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

@@ -16,7 +16,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, ReplayBuffer, probe_env_spec,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, ReplayBuffer, probe_env_spec,
                              rollout_result)
 from ray_tpu.rl.dqn import _EpsilonWorker, init_qnet, q_forward
 
@@ -128,7 +128,7 @@ class ApexDQNTrainer(Algorithm):
                 cfg.prioritized_alpha, cfg.seed + s)
             for s in range(cfg.num_replay_shards)]
         self.workers = [
-            _EpsilonWorker.options(num_cpus=0.4).remote(
+            _EpsilonWorker.options(num_cpus=0.4, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         n = max(1, cfg.num_rollout_workers - 1)
@@ -310,7 +310,7 @@ class ApexDDPGTrainer(Algorithm):
                 cfg.prioritized_alpha, cfg.seed + s)
             for s in range(cfg.num_replay_shards)]
         self.workers = [
-            _TD3Worker.options(num_cpus=0.4).remote(
+            _TD3Worker.options(num_cpus=0.4, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         n = max(1, cfg.num_rollout_workers - 1)

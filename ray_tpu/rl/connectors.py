@@ -143,6 +143,56 @@ class NormalizeObs(Connector):
         return {"count": count, "mean": mean, "m2": m2}
 
 
+class GrayscaleObs(Connector):
+    """RGB [H, W, 3] -> luma [H, W, 1] (ref: atari_wrappers.py WarpFrame
+    grayscale step). Keeps a trailing channel axis so FrameStack stacks
+    frames along channels."""
+
+    WEIGHTS = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float32)
+        if x.ndim == 3 and x.shape[-1] == 3:
+            x = x @ self.WEIGHTS
+        return x[..., None] if x.ndim == 2 else x
+
+
+class ResizeObs(Connector):
+    """Spatial resize for image obs (ref: WarpFrame's cv2.resize — done
+    here with block-mean pooling when the ratio divides evenly, else
+    nearest-neighbor sampling; no cv2 in the image)."""
+
+    def __init__(self, height: int, width: int):
+        self.h, self.w = height, width
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float32)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[..., None]
+        H, W, C = x.shape
+        if (H, W) == (self.h, self.w):
+            out = x
+        elif H % self.h == 0 and W % self.w == 0:
+            fh, fw = H // self.h, W // self.w
+            out = x.reshape(self.h, fh, self.w, fw, C).mean((1, 3))
+        else:
+            ri = (np.arange(self.h) * H // self.h)
+            ci = (np.arange(self.w) * W // self.w)
+            out = x[ri][:, ci]
+        return out[..., 0] if squeeze else out
+
+
+class ScaleObs(Connector):
+    """Multiply by a constant (e.g. 1/255 for uint8 pixels)."""
+
+    def __init__(self, scale: float):
+        self.scale = float(scale)
+
+    def __call__(self, x):
+        return np.asarray(x, np.float32) * self.scale
+
+
 class FrameStack(Connector):
     """Stack the last k observations along the feature axis
     (ref: rllib frame-stacking agent connector)."""

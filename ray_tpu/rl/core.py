@@ -86,6 +86,32 @@ def mlp_forward(layers, x, final_activation=False):
 # --- rollout sampling --------------------------------------------------------
 
 
+# Rollout actors must never grab the TPU: the learner owns it, and a
+# worker that initializes jax on the chip deadlocks the single-chip bench
+# box. process_env_vars applies at worker-process spawn, BEFORE jax import
+# (runtime_env.py) — EnvSampler's in-process setdefault alone is too late
+# when the worker pool prestarted a process that already imported jax.
+CPU_WORKER_ENV = {"process_env_vars": {"JAX_PLATFORMS": "cpu",
+                                       "PALLAS_AXON_POOL_IPS": ""}}
+
+
+def make_env(env_name: str, env_config: Optional[dict] = None):
+    """Construct an env. "module:Class" names import and instantiate
+    directly (no registry round-trip — works in any worker process, e.g.
+    "ray_tpu.rl.pixel_env:PixelCatcher"); everything else goes through
+    gymnasium.make (ref: rllib env_creator resolution in
+    rllib/env/utils.py)."""
+    if ":" in env_name:
+        import importlib
+
+        mod_name, cls_name = env_name.split(":", 1)
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        return cls(**(env_config or {}))
+    import gymnasium as gym
+
+    return gym.make(env_name, **(env_config or {}))
+
+
 class EnvSampler:
     """Shared env-loop plumbing for rollout actors: env construction,
     episode-return accounting, reset handling (ref: rollout_worker.py
@@ -96,9 +122,8 @@ class EnvSampler:
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        import gymnasium as gym
 
-        self.env = gym.make(env_name, **(env_config or {}))
+        self.env = make_env(env_name, env_config)
         self.seed = seed
         self.obs, _ = self.env.reset(seed=seed)
         self.steps = 0
@@ -262,9 +287,7 @@ class Algorithm:
 
 def probe_env_spec(env_name: str, env_config: Optional[dict] = None):
     """(obs_dim, n_actions | None, act_dim | None, act_high)."""
-    import gymnasium as gym
-
-    env = gym.make(env_name, **(env_config or {}))
+    env = make_env(env_name, env_config)
     obs_dim = int(np.prod(env.observation_space.shape))
     n_actions = act_dim = act_high = None
     if hasattr(env.action_space, "n"):

@@ -14,7 +14,7 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, ReplayBuffer, mlp_init,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, ReplayBuffer, mlp_init,
                              probe_env_spec)
 from ray_tpu.rl.td3 import _TD3Worker, policy_action, q_value
 
@@ -68,7 +68,7 @@ class DDPGTrainer(Algorithm):
         self.critic_os = self.critic_opt.init(self.nets["q"])
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _TD3Worker.options(num_cpus=0.5).remote(
+            _TD3Worker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

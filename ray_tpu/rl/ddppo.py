@@ -17,9 +17,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, probe_env_spec
-from ray_tpu.rl.ppo import (RolloutWorker, compute_gae, init_policy,
-                            make_ppo_loss)
+from ray_tpu.rl.core import CPU_WORKER_ENV, Algorithm
+from ray_tpu.rl.ppo import RolloutWorker, compute_gae, make_ppo_loss
 
 
 @ray_tpu.remote(num_cpus=0.5)
@@ -29,10 +28,11 @@ class _DDPPOWorker:
     process group)."""
 
     def __init__(self, env: str, seed: int, env_config: dict,
-                 cfg_dict: dict):
+                 cfg_dict: dict, connectors=None):
         import jax
 
-        self.inner = RolloutWorker._cls(env, seed, env_config)
+        self.inner = RolloutWorker._cls(env, seed, env_config,
+                                        connectors=connectors)
         self.cfg = cfg_dict
         self.rng = np.random.default_rng(seed)
         self.batch = None
@@ -83,6 +83,12 @@ class DDPPOConfig:
     vf_coeff: float = 0.5
     entropy_coeff: float = 0.01
     hidden: int = 64
+    # connector factories + network choice, same semantics as PPOConfig.
+    # Connector state here is per-worker only (experience never leaves the
+    # worker, so there is no central merge point by design).
+    obs_connectors: Any = None
+    network: str = "auto"
+    cnn_hidden: int = 512
     seed: int = 0
 
 
@@ -95,10 +101,20 @@ class DDPPOTrainer(Algorithm):
         import jax
         import optax
 
-        obs_dim, n_actions, _a, _h = probe_env_spec(cfg.env, cfg.env_config)
-        assert n_actions is not None, "DDPPO here supports discrete actions"
-        self.params = init_policy(jax.random.PRNGKey(cfg.seed), obs_dim,
-                                  n_actions, cfg.hidden)
+        from ray_tpu.rl.connectors import build_pipeline
+        from ray_tpu.rl.core import make_env
+        from ray_tpu.rl.ppo import init_any_policy
+
+        probe = make_env(cfg.env, cfg.env_config)
+        obs0, _ = probe.reset(seed=cfg.seed)
+        assert hasattr(probe.action_space, "n"), \
+            "DDPPO here supports discrete actions"
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        pipeline = build_pipeline(cfg.obs_connectors)
+        obs_shape = pipeline(np.asarray(obs0, np.float32)).shape
+        self.params = init_any_policy(jax.random.PRNGKey(cfg.seed),
+                                      obs_shape, n_actions, cfg)
         self.opt = optax.adam(cfg.lr)
         self.opt_state = self.opt.init(self.params)
         cfg_dict = {"gamma": cfg.gamma, "lam": cfg.lam, "clip": cfg.clip,
@@ -106,8 +122,9 @@ class DDPPOTrainer(Algorithm):
                     "entropy_coeff": cfg.entropy_coeff,
                     "minibatch_size": cfg.minibatch_size}
         self.workers = [
-            _DDPPOWorker.remote(cfg.env, cfg.seed + i * 1000,
-                                cfg.env_config, cfg_dict)
+            _DDPPOWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.env, cfg.seed + i * 1000,
+                                cfg.env_config, cfg_dict,
+                                cfg.obs_connectors)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0
         self._apply = jax.jit(self._make_apply())

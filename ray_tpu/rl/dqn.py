@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
                              mlp_init, probe_env_spec)
 
 
@@ -113,7 +113,7 @@ class DQNTrainer(Algorithm):
         self.opt_state = self.opt.init(self.net)
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _EpsilonWorker.options(num_cpus=0.5).remote(
+            _EpsilonWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

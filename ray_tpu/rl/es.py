@@ -17,7 +17,7 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, episode_stats_from, probe_env_spec
+from ray_tpu.rl.core import CPU_WORKER_ENV, Algorithm, episode_stats_from, probe_env_spec
 
 
 # --- deterministic flat-vector policy ---------------------------------------
@@ -138,7 +138,7 @@ class _EvolutionBase(Algorithm):
         rng = np.random.default_rng(cfg.seed)
         self.flat = (rng.standard_normal(self.dim) * 0.05).astype(np.float32)
         self.workers = [
-            _ESWorker.options(num_cpus=0.5).remote(
+            _ESWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.env_config, obs_dim, out_dim, cfg.hidden,
                 self.discrete, act_high or 1.0, cfg.max_episode_steps)
             for _ in range(cfg.num_rollout_workers)]

@@ -14,8 +14,8 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, probe_env_spec
-from ray_tpu.rl.ppo import RolloutWorker, init_policy, policy_forward
+from ray_tpu.rl.core import Algorithm, CPU_WORKER_ENV
+from ray_tpu.rl.ppo import RolloutWorker, policy_forward
 
 
 @dataclass
@@ -33,6 +33,11 @@ class ImpalaConfig:
     entropy_coeff: float = 0.01
     hidden: int = 64
     seed: int = 0
+    # connector factories + network choice, same semantics as PPOConfig
+    # (pixel IMPALA: atari_connectors() + the auto-selected NatureCNN)
+    obs_connectors: Any = None
+    network: str = "auto"
+    cnn_hidden: int = 512
 
 
 class ImpalaTrainer(Algorithm):
@@ -43,16 +48,28 @@ class ImpalaTrainer(Algorithm):
         import jax
         import optax
 
-        obs_dim, n_actions, _, _ = probe_env_spec(cfg.env, cfg.env_config)
-        assert n_actions is not None, "IMPALA zoo variant is discrete-action"
-        self.params = init_policy(jax.random.PRNGKey(cfg.seed), obs_dim,
-                                  n_actions, cfg.hidden)
+        from ray_tpu.rl.connectors import build_pipeline
+        from ray_tpu.rl.core import make_env
+        from ray_tpu.rl.ppo import init_any_policy
+
+        probe = make_env(cfg.env, cfg.env_config)
+        obs0, _ = probe.reset(seed=cfg.seed)
+        assert hasattr(probe.action_space, "n"), \
+            "IMPALA zoo variant is discrete-action"
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        self.pipeline = build_pipeline(cfg.obs_connectors)
+        obs_shape = self.pipeline(np.asarray(obs0, np.float32)).shape
+        self._conn_abs = None  # authoritative merged connector state
+        self.params = init_any_policy(jax.random.PRNGKey(cfg.seed),
+                                      obs_shape, n_actions, cfg)
         self.opt = optax.adam(cfg.lr)
         self.opt_state = self.opt.init(self.params)
         self.workers = [
-            RolloutWorker.options(num_cpus=0.5).remote(
+            RolloutWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, seed=cfg.seed + i * 1000,
-                env_config=cfg.env_config)
+                env_config=cfg.env_config,
+                connectors=cfg.obs_connectors)
             for i in range(cfg.num_rollout_workers)]
         self._inflight: Dict[Any, Any] = {}   # ref -> worker
         self.timesteps = 0
@@ -113,8 +130,11 @@ class ImpalaTrainer(Algorithm):
         return update
 
     def _launch(self, worker, params_host):
+        # the merged absolute connector state rides along with the weights,
+        # same collect/merge/broadcast cycle as PPOTrainer.train
         ref = worker.sample.remote(params_host,
-                                   self.config.rollout_fragment_length)
+                                   self.config.rollout_fragment_length,
+                                   self._conn_abs)
         self._inflight[ref] = worker
 
     def training_step(self) -> Dict[str, Any]:
@@ -139,6 +159,10 @@ class ImpalaTrainer(Algorithm):
                     break
                 worker = self._inflight.pop(ref)
                 b = ray_tpu.get(ref)
+                delta = b.pop("connector_state", None)
+                if delta is not None:
+                    self._conn_abs = self.pipeline.merge_pipeline_states(
+                        [delta], prev=self._conn_abs)
                 batch = {
                     "obs": jnp.asarray(b["obs"]),
                     "actions": jnp.asarray(b["actions"]),

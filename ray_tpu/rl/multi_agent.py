@@ -21,7 +21,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, episode_stats_from
+from ray_tpu.rl.core import (Algorithm, CPU_WORKER_ENV,
+                             episode_stats_from)
 from ray_tpu.rl.ppo import (categorical_sample, compute_gae, init_policy,
                             make_ppo_update, policy_forward, run_ppo_epochs)
 
@@ -254,7 +255,7 @@ class MultiAgentPPOTrainer(Algorithm):
             self.opt_states[pid] = self.opt.init(self.policies[pid])
 
         self.workers = [
-            MultiAgentRolloutWorker.options(num_cpus=0.5).remote(
+            MultiAgentRolloutWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.env_config, mapping, seed=cfg.seed + i * 1000)
             for i in range(cfg.num_rollout_workers)]
         self._update = jax.jit(self._make_update())

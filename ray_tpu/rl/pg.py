@@ -15,7 +15,8 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, probe_env_spec,
+from ray_tpu.rl.core import (Algorithm, CPU_WORKER_ENV,
+                             probe_env_spec,
                              reward_to_go, rollout_result)
 from ray_tpu.rl.ppo import RolloutWorker, init_policy, policy_forward
 
@@ -47,7 +48,7 @@ class PGTrainer(Algorithm):
         self.opt = optax.adam(cfg.lr)
         self.opt_state = self.opt.init(self.params)
         self.workers = [
-            RolloutWorker.options(num_cpus=0.5).remote(
+            RolloutWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import EnvSampler
+from ray_tpu.rl.core import CPU_WORKER_ENV, EnvSampler
 
 
 # --- policy (pure JAX, shared by learner and rollout workers) ----------------
@@ -39,12 +39,32 @@ def init_policy(key, obs_dim: int, n_actions: int, hidden: int = 64):
 def policy_forward(params, obs):
     import jax.numpy as jnp
 
+    if "conv" in params:
+        # pixel policy (rl/vision.py NatureCNN); PPO/IMPALA/APPO/DDPPO all
+        # route through here, so the whole actor-critic family gains pixel
+        # support from the one dispatch
+        from ray_tpu.rl.vision import vision_forward
+
+        return vision_forward(params, obs)
     x = obs
     for layer in params["torso"]:
         x = jnp.tanh(x @ layer["w"] + layer["b"])
     logits = x @ params["pi"]["w"] + params["pi"]["b"]
     value = (x @ params["v"]["w"] + params["v"]["b"])[..., 0]
     return logits, value
+
+
+def init_any_policy(key, obs_shape, n_actions: int, cfg):
+    """MLP for flat obs, NatureCNN for [H, W, C] obs (cfg.network
+    "auto" | "mlp" | "cnn"; ref: rllib model catalog dispatch,
+    rllib/models/catalog.py -> visionnet.py:22)."""
+    net = getattr(cfg, "network", "auto")
+    if net == "cnn" or (net == "auto" and len(obs_shape) == 3):
+        from ray_tpu.rl.vision import init_vision_policy
+
+        return init_vision_policy(key, obs_shape, n_actions,
+                                  hidden=getattr(cfg, "cnn_hidden", 512))
+    return init_policy(key, int(np.prod(obs_shape)), n_actions, cfg.hidden)
 
 
 def categorical_sample(logits_row: np.ndarray, rng):
@@ -95,10 +115,18 @@ class RolloutWorker(EnvSampler):
             [], [], [], [], [], []
         if self._obs_t is None:
             self._obs_t = self.pipeline(np.asarray(self.obs, np.float32))
+        # params to device ONCE per fragment, forward jitted ONCE per
+        # process: per-step eager dispatch dominates CNN rollouts
+        # otherwise (~10x on the pixel env)
+        import jax
+
+        if not hasattr(self, "_jit_fwd"):
+            self._jit_fwd = jax.jit(policy_forward)
+        params_dev = jax.tree.map(jnp.asarray, params_host)
         for _ in range(num_steps):
             obs_t = self._obs_t
-            logits, value = policy_forward(params_host,
-                                           jnp.asarray(obs_t)[None])
+            logits, value = self._jit_fwd(params_dev,
+                                          jnp.asarray(obs_t)[None])
             action, logp = categorical_sample(np.asarray(logits)[0], rng)
             _prev, rew, term, trunc, _nobs = self.step_env(action)
             if term or trunc:
@@ -111,8 +139,7 @@ class RolloutWorker(EnvSampler):
             logp_buf.append(logp)
             val_buf.append(float(np.asarray(value)[0]))
         # bootstrap value for the final (connected) state
-        _, last_v = policy_forward(params_host,
-                                   jnp.asarray(self._obs_t)[None])
+        _, last_v = self._jit_fwd(params_dev, jnp.asarray(self._obs_t)[None])
         out = {
             "obs": np.stack(obs_buf),
             "actions": np.asarray(act_buf, np.int32),
@@ -234,6 +261,10 @@ class PPOConfig:
     # connector FACTORIES (zero-arg callables) so every worker gets its
     # own stateful instances (ref: rllib connectors_v2 config)
     obs_connectors: Optional[List[Any]] = None
+    # "auto": CNN when the connected obs is [H, W, C], MLP otherwise
+    # (ref: rllib model catalog picks VisionNetwork for image spaces)
+    network: str = "auto"
+    cnn_hidden: int = 512
 
 
 class PPOTrainer:
@@ -241,28 +272,27 @@ class PPOTrainer:
     worker fleet, update on device, broadcast new weights."""
 
     def __init__(self, config: PPOConfig):
-        import gymnasium as gym
         import jax
         import optax
 
         from ray_tpu.rl.connectors import build_pipeline
 
         self.cfg = config
-        probe = gym.make(config.env, **config.env_config)
+        from ray_tpu.rl.core import make_env
+
+        probe = make_env(config.env, config.env_config)
         obs0, _ = probe.reset(seed=config.seed)
         n_actions = int(probe.action_space.n)
         probe.close()
-        # obs dim AFTER the connector pipeline (e.g. FrameStack widens it)
+        # obs shape AFTER the connector pipeline (e.g. FrameStack widens it)
         self.pipeline = build_pipeline(config.obs_connectors)
-        obs_dim = int(np.prod(
-            self.pipeline(np.asarray(obs0, np.float32)).shape))
-
-        self.params = init_policy(jax.random.PRNGKey(config.seed), obs_dim,
-                                  n_actions, config.hidden)
+        obs_shape = self.pipeline(np.asarray(obs0, np.float32)).shape
+        self.params = init_any_policy(
+            jax.random.PRNGKey(config.seed), obs_shape, n_actions, config)
         self.opt = optax.adam(config.lr)
         self.opt_state = self.opt.init(self.params)
         self.workers = [
-            RolloutWorker.options(num_cpus=0.5).remote(
+            RolloutWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 config.env, seed=config.seed + i * 1000,
                 env_config=config.env_config,
                 connectors=config.obs_connectors)

@@ -18,7 +18,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, EnvSampler, ReplayBuffer,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, EnvSampler, ReplayBuffer,
                              dense_init, mlp_forward, mlp_init,
                              probe_env_spec)
 
@@ -167,7 +167,7 @@ class R2D2Trainer(Algorithm):
         seq_len = cfg.burn_in + cfg.train_len
         self.seq_len = seq_len
         self.workers = [
-            _R2D2Worker.remote(cfg.env, cfg.seed + i * 1000, cfg.hidden,
+            _R2D2Worker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.env, cfg.seed + i * 1000, cfg.hidden,
                                cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

@@ -12,7 +12,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
                              mlp_init, probe_env_spec)
 
 LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
@@ -132,7 +132,7 @@ class SACTrainer(Algorithm):
 
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _SACWorker.options(num_cpus=0.5).remote(
+            _SACWorker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

@@ -14,7 +14,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, EnvSampler, ReplayBuffer, mlp_forward,
                              mlp_init, probe_env_spec)
 
 
@@ -110,7 +110,7 @@ class TD3Trainer(Algorithm):
             {"q1": self.nets["q1"], "q2": self.nets["q2"]})
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _TD3Worker.options(num_cpus=0.5).remote(
+            _TD3Worker.options(num_cpus=0.5, runtime_env=CPU_WORKER_ENV).remote(
                 cfg.env, cfg.seed + i * 1000, cfg.env_config)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

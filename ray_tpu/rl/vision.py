@@ -1,0 +1,96 @@
+"""Vision (CNN) policy network for pixel observations.
+
+Reference: rllib/models/torch/visionnet.py:22 (VisionNetwork — the conv
+stack rllib attaches for image observations, defaulting to the Nature-DQN
+filters) and rllib/models/utils.py get_filter_config (84x84 -> [32 8x8/4,
+64 4x4/2, 64 3x3/1]). TPU shape: the whole network is pure JAX on NHWC
+tensors so the jitted learner update runs conv + dense on the MXU in one
+compiled function; rollout actors run the same function on CPU.
+
+The params dict carries a "conv" key, which is how
+ppo.policy_forward dispatches between the MLP and this network — PPO,
+IMPALA, APPO and DDPPO all route through that one entry point, so every
+actor-critic algorithm in the zoo gains pixel support from this module.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+# Nature-DQN filter config (ref: rllib/models/utils.py get_filter_config)
+NATURE_FILTERS = ((32, (8, 8), 4), (64, (4, 4), 2), (64, (3, 3), 1))
+
+
+def conv_out_hw(h: int, w: int,
+                filters=NATURE_FILTERS) -> Tuple[int, int]:
+    """Spatial dims after the conv stack (VALID padding)."""
+    for _, (kh, kw), s in filters:
+        h = (h - kh) // s + 1
+        w = (w - kw) // s + 1
+    return h, w
+
+
+def init_vision_policy(key, obs_shape: Sequence[int], n_actions: int,
+                       hidden: int = 512, filters=NATURE_FILTERS):
+    """obs_shape: (H, W, C) AFTER the connector pipeline (e.g. 84x84x4
+    for grayscale frame-stack). Returns a params dict compatible with
+    ppo.policy_forward's dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    H, W, C = obs_shape
+    keys = jax.random.split(key, len(filters) + 3)
+    conv = []
+    cin = C
+    # strides stay OUT of the params pytree (static config, not a
+    # differentiable leaf); vision_forward reads them from `filters`
+    for i, (cout, (kh, kw), _stride) in enumerate(filters):
+        fan_in = kh * kw * cin
+        conv.append({
+            "w": jax.random.normal(keys[i], (kh, kw, cin, cout))
+            * (2.0 / fan_in) ** 0.5,
+            "b": jnp.zeros((cout,)),
+        })
+        cin = cout
+    oh, ow = conv_out_hw(H, W, filters)
+    flat = oh * ow * cin
+    if oh <= 0 or ow <= 0:
+        raise ValueError(
+            f"obs {tuple(obs_shape)} too small for the conv stack "
+            f"(got {oh}x{ow} after convs); resize up or shrink filters")
+
+    def dense(k, i, o, scale=None):
+        s = (2.0 / i) ** 0.5 if scale is None else scale
+        return {"w": jax.random.normal(k, (i, o)) * s,
+                "b": jnp.zeros((o,))}
+
+    return {
+        "conv": conv,
+        "head": dense(keys[-3], flat, hidden),
+        # small-init pi head: near-uniform initial policy (standard for
+        # pixel PPO; large initial logits collapse exploration)
+        "pi": dense(keys[-2], hidden, n_actions, scale=0.01),
+        "v": dense(keys[-1], hidden, 1),
+    }
+
+
+def vision_forward(params, obs, filters=NATURE_FILTERS):
+    """obs [B, H, W, C] float (already scaled by the connector pipeline)
+    -> (logits [B, A], value [B]). `filters` must match the config the
+    params were initialized with (strides are static, not params)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(obs)
+    for layer, (_cout, _k, stride) in zip(params["conv"], filters):
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(stride, stride), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + layer["b"])
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["head"]["w"] + params["head"]["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["v"]["w"] + params["v"]["b"])[..., 0]
+    return logits, value

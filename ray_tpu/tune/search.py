@@ -522,18 +522,20 @@ class OptunaSearch(Searcher):
             self._study = optuna.create_study(
                 direction="maximize" if self.mode == "max" else "minimize",
                 sampler=sampler)
-            for cfg, value, failed in self._history:
-                if failed or value is None:
-                    continue
+            completed = [(cfg, value) for cfg, value, failed in self._history
+                         if not failed and value is not None]
+            if completed:
                 try:
-                    self._study.add_trial(optuna.trial.create_trial(
-                        params={k: v for k, v in cfg.items()
-                                if k in self._distributions()},
-                        distributions=self._distributions(), value=value))
+                    dists = self._distributions()
+                    for cfg, value in completed:
+                        self._study.add_trial(optuna.trial.create_trial(
+                            params={k: v for k, v in cfg.items()
+                                    if k in dists},
+                            distributions=dists, value=value))
                 except Exception:
                     # replay is best-effort: a study that forgot history
                     # still suggests valid configs
-                    break
+                    pass
         return self._study
 
     def _distributions(self):

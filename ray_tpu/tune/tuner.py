@@ -307,16 +307,29 @@ class Tuner:
         # trial must see exactly what the original saw; the json stays
         # human-readable for status polling
         tmp2 = os.path.join(run_dir, ".configs.tmp")
+
+        def picklable(tree: dict) -> dict:
+            # drop only the offending entries — one unpicklable metric
+            # value must not discard every typed config
+            out = {}
+            for tid, val in tree.items():
+                try:
+                    pickle.dumps(val)
+                    out[tid] = val
+                except Exception:
+                    pass
+            return out
+
         try:
             with open(tmp2, "wb") as f:
-                pickle.dump({"configs": {tid: rec["config"]
-                                         for tid, rec in self._exp.items()},
-                             "metrics": {tid: rec["metrics"]
-                                         for tid, rec in self._exp.items()}},
-                            f)
+                pickle.dump({"configs": picklable(
+                    {tid: rec["config"] for tid, rec in self._exp.items()}),
+                    "metrics": picklable(
+                    {tid: rec["metrics"] for tid, rec in self._exp.items()})},
+                    f)
             os.replace(tmp2, os.path.join(run_dir, "configs.pkl"))
         except Exception:
-            pass  # unpicklable config value: restore falls back to json
+            pass  # sidecar is best-effort: restore falls back to json
 
     def _save_meta(self, run_dir: Optional[str]) -> None:
         import pickle

@@ -1,0 +1,74 @@
+"""Pixel-RL throughput benchmark (BASELINE.json target 5: "PPO Atari —
+TPU learner + CPU rollout actors").
+
+ALE is not in the image; PixelCatcher (rl/pixel_env.py) drives the same
+pixel pipeline (RGB -> grayscale -> resize -> stack -> NatureCNN). Emits
+one JSON line with env steps/s (rollout fan-in) and learner SGD
+minibatch steps/s (the jitted CNN update on the local backend — the real
+TPU when run under the bench harness).
+
+    python release/rl_benchmark.py [--iters 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--fragment", type=int, default=256)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.rl.pixel_env import atari_connectors
+    from ray_tpu.rl.ppo import PPOConfig, PPOTrainer
+
+    ray_tpu.init(num_cpus=max(8, args.workers * 2))
+    cfg = PPOConfig(
+        env="ray_tpu.rl.pixel_env:PixelCatcher",
+        env_config={"dense_reward": True},
+        obs_connectors=atari_connectors(stack=4, out_size=42),
+        num_rollout_workers=args.workers,
+        rollout_fragment_length=args.fragment,
+        num_epochs=4, minibatch_size=128, lr=5e-4)
+    tr = PPOTrainer(cfg)
+    tr.train()                                   # warmup (jit compile)
+
+    env_steps = 0
+    sgd_steps = 0
+    t0 = time.time()
+    last_ret = 0.0
+    for _ in range(args.iters):
+        r = tr.train()
+        n = r["timesteps_this_iter"]
+        env_steps += n
+        sgd_steps += cfg.num_epochs * max(n // cfg.minibatch_size, 1)
+        last_ret = r["episode_return_mean"]
+    dt = time.time() - t0
+    tr.stop()
+    ray_tpu.shutdown()
+
+    print(json.dumps({
+        "metric": "ppo_pixel_env_steps_per_sec",
+        "value": round(env_steps / dt, 1),
+        "unit": "env steps/s",
+        "extra": {
+            "learner_sgd_steps_per_sec": round(sgd_steps / dt, 1),
+            "workers": args.workers, "fragment": args.fragment,
+            "obs": "42x42x4 (from 84x84x3 RGB)",
+            "episode_return_mean": round(last_ret, 2),
+        },
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

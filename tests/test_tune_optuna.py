@@ -59,6 +59,9 @@ class _MockStudy:
     def tell(self, trial, value=None, state=None):
         self.told.append((trial.number, value, state))
 
+    def add_trial(self, frozen):
+        self.told.append(("replay", frozen["value"], None))
+
 
 def _install_mock_optuna(monkeypatch):
     optuna = types.ModuleType("optuna")
@@ -74,6 +77,24 @@ def _install_mock_optuna(monkeypatch):
 
     samplers.TPESampler = TPESampler
     trialmod.TrialState = TrialState
+
+    # distributions + replay surface (used by OptunaSearch restore)
+    distmod = types.ModuleType("optuna.distributions")
+
+    class _Dist:
+        def __init__(self, *a, **k):
+            self.args = a
+            self.kw = k
+
+    distmod.FloatDistribution = _Dist
+    distmod.IntDistribution = _Dist
+    distmod.CategoricalDistribution = _Dist
+    optuna.distributions = distmod
+
+    def create_trial(params=None, distributions=None, value=None):
+        return {"params": params, "value": value}
+
+    trialmod.create_trial = create_trial
     created = []
 
     def create_study(direction="maximize", sampler=None):
