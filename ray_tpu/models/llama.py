@@ -531,6 +531,112 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
     return logits, KVCache(nk, nv, new_len)
 
 
+def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int,
+                     dtype=None):
+    """Paged KV pools [L, KV, num_pages, page_size, HD] (SURVEY §7.9 /
+    ops/paged_attention.py layout; page 0 is the trash page inactive
+    slots write into). HBM scales with pages, not slots*max_seq."""
+    dt = dtype or cfg.dtype
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_pages, page_size,
+             cfg.head_dim)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def decode_step_paged(params, tokens, k_pools, v_pools, page_table,
+                      lengths, cfg: LlamaConfig, active=None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One continuous-batching decode step over a PAGED KV cache.
+    tokens [S, 1]; k_pools/v_pools [L, KV, NP, ps, HD]; page_table
+    [S, maxP]; lengths [S] = tokens already stored per slot. Returns
+    (logits [S, V], new k_pools, new v_pools, new lengths). Rows with
+    active==0 write their k/v into the trash page 0 and keep length.
+    The attention itself is ops/paged_attention.py's Pallas kernel
+    (XLA-gather reference off-TPU)."""
+    from ray_tpu.ops.paged_attention import paged_attention
+
+    if cfg.sliding_window is not None:
+        raise ValueError("paged decode does not support sliding_window")
+    dt = cfg.dtype
+    S = tokens.shape[0]
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ps = k_pools.shape[3]
+    if active is None:
+        active = jnp.ones((S,), jnp.int32)
+    pos = lengths                                          # write position
+    cos_full, sin_full = _rope_tables(cfg.rope_theta, cfg.max_seq_len,
+                                      cfg.head_dim)
+    cos = cos_full[pos][:, None, :]
+    sin = sin_full[pos][:, None, :]
+
+    def rope1(x):  # [S, 1, N, HD] with per-row tables
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                               axis=-1).astype(x.dtype)
+
+    # physical write target per slot; inactive rows land in trash page 0
+    page_slot = jnp.take_along_axis(page_table,
+                                    (pos // ps)[:, None], axis=1)[:, 0]
+    page_slot = jnp.where(active > 0, page_slot, 0)
+    offset = pos % ps
+    attn_len = jnp.where(active > 0, pos + 1, 0)
+
+    x = params["embed"].astype(dt)[tokens]                 # [S, 1, D]
+
+    def body(x, inp):
+        lp, kp, vp = inp                                   # kp [KV,NP,ps,HD]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = rope1((h @ lp["wq"].astype(dt)).reshape(S, 1, H, HD))
+        k = rope1((h @ lp["wk"].astype(dt)).reshape(S, 1, KV, HD))
+        v = (h @ lp["wv"].astype(dt)).reshape(S, 1, KV, HD)
+        kp = kp.at[:, page_slot, offset, :].set(
+            k[:, 0].transpose(1, 0, 2).astype(kp.dtype))
+        vp = vp.at[:, page_slot, offset, :].set(
+            v[:, 0].transpose(1, 0, 2).astype(vp.dtype))
+        o = paged_attention(q[:, 0].astype(dt), kp.astype(dt),
+                            vp.astype(dt), page_table, attn_len)
+        # fully-masked (inactive) rows return garbage — zero them
+        o = jnp.where((active > 0)[:, None, None], o, 0.0)
+        x = x + o.reshape(S, 1, H * HD) @ lp["wo"].astype(dt)
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (kp, vp)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], k_pools,
+                                         v_pools))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, nk, nv, lengths + active
+
+
+def scatter_prefill_pages(k_pools, v_pools, ks, vs, page_table, slots,
+                          lengths, page_size: int):
+    """Write prefill k/v into the pools. ks/vs [L, n, P, KV, HD] (from
+    llama.prefill), slots [n] slot ids, lengths [n] true lengths;
+    positions past a row's length go to trash page 0. Returns updated
+    pools."""
+    L, n, P, KV, HD = ks.shape
+    ps = page_size
+    pos = jnp.arange(P)[None, :]                           # [1, P]
+    chunk = pos // ps                                      # [1, P]
+    pages = jnp.take_along_axis(
+        page_table[slots], jnp.broadcast_to(chunk, (n, P)), axis=1)
+    pages = jnp.where(pos < lengths[:, None], pages, 0)    # [n, P]
+    offs = jnp.broadcast_to(pos % ps, (n, P))
+    pages_f = pages.reshape(-1)
+    offs_f = offs.reshape(-1)
+    k_f = ks.transpose(0, 3, 1, 2, 4).reshape(L, KV, n * P, HD)
+    v_f = vs.transpose(0, 3, 1, 2, 4).reshape(L, KV, n * P, HD)
+    k_pools = k_pools.at[:, :, pages_f, offs_f, :].set(
+        k_f.astype(k_pools.dtype))
+    v_pools = v_pools.at[:, :, pages_f, offs_f, :].set(
+        v_f.astype(v_pools.dtype))
+    return k_pools, v_pools
+
+
 def forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig,
                        offset) -> Tuple[jax.Array, KVCache]:
     """Run [B, S] tokens at position `offset` (scalar — uniform across batch

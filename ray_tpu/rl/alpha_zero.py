@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, ReplayBuffer, mlp_forward, mlp_init
+from ray_tpu.rl.core import Algorithm, CPU_WORKER_ENV, ReplayBuffer, mlp_forward, mlp_init
 
 
 # --- game: TicTacToe ---------------------------------------------------------
@@ -250,7 +250,7 @@ class AlphaZeroTrainer(Algorithm):
         self.opt_state = self.opt.init(self.net)
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _SelfPlayWorker.remote(cfg.seed + i * 1000, cfg.num_sims,
+            _SelfPlayWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.seed + i * 1000, cfg.num_sims,
                                    cfg.c_puct, cfg.temperature)
             for i in range(cfg.num_rollout_workers)]
         self.games_total = 0

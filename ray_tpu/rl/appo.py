@@ -55,17 +55,12 @@ class APPOTrainer(Algorithm):
         import optax
 
         from ray_tpu.rl.connectors import build_pipeline
-        from ray_tpu.rl.core import make_env
+        from ray_tpu.rl.core import probe_connected_spec
         from ray_tpu.rl.ppo import init_any_policy
 
-        probe = make_env(cfg.env, cfg.env_config)
-        obs0, _ = probe.reset(seed=cfg.seed)
-        assert hasattr(probe.action_space, "n"), \
-            "APPO zoo variant is discrete-action"
-        n_actions = int(probe.action_space.n)
-        probe.close()
+        obs_shape, n_actions = probe_connected_spec(
+            cfg.env, cfg.env_config, cfg.obs_connectors, cfg.seed)
         self.pipeline = build_pipeline(cfg.obs_connectors)
-        obs_shape = self.pipeline(np.asarray(obs0, np.float32)).shape
         self._conn_abs = None
         self.params = init_any_policy(jax.random.PRNGKey(cfg.seed),
                                       obs_shape, n_actions, cfg)

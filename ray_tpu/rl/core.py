@@ -285,6 +285,28 @@ class Algorithm:
                 pass
 
 
+def probe_connected_spec(env_name: str, env_config: Optional[dict],
+                         connectors, seed: int = 0):
+    """(obs_shape_after_connectors, n_actions) for a discrete-action env
+    — the shared probe used by every actor-critic trainer (PPO/IMPALA/
+    APPO/DDPPO) to size its policy net. Always closes the probe env."""
+    from ray_tpu.rl.connectors import build_pipeline
+
+    env = make_env(env_name, env_config)
+    try:
+        obs0, _ = env.reset(seed=seed)
+        if not hasattr(env.action_space, "n"):
+            raise ValueError(
+                f"{env_name} is not discrete-action; this trainer family "
+                "requires a Discrete action space")
+        n_actions = int(env.action_space.n)
+    finally:
+        env.close()
+    pipeline = build_pipeline(connectors)
+    obs_shape = pipeline(np.asarray(obs0, np.float32)).shape
+    return obs_shape, n_actions
+
+
 def probe_env_spec(env_name: str, env_config: Optional[dict] = None):
     """(obs_dim, n_actions | None, act_dim | None, act_high)."""
     env = make_env(env_name, env_config)

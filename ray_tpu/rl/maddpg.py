@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, ReplayBuffer, episode_stats_from,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, ReplayBuffer, episode_stats_from,
                              mlp_forward, mlp_init)
 from ray_tpu.rl.multi_agent import (MultiAgentEnv, make_multi_agent_env,
                                     register_multi_agent_env)
@@ -216,7 +216,7 @@ class MADDPGTrainer(Algorithm):
         self.critic_os = [self.copt.init(c) for c in self.nets["critics"]]
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _MADDPGWorker.remote(cfg.env, cfg.env_config,
+            _MADDPGWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.env, cfg.env_config,
                                  cfg.seed + i * 1000)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

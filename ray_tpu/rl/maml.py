@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Tuple
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, mlp_forward, mlp_init
+from ray_tpu.rl.core import Algorithm, CPU_WORKER_ENV, mlp_forward, mlp_init
 
 
 # --- task env: point navigation ---------------------------------------------
@@ -194,7 +194,7 @@ class MAMLTrainer(Algorithm):
         self.opt = optax.adam(cfg.meta_lr)
         self.opt_state = self.opt.init(self.params)
         self.workers = [
-            _MAMLWorker.remote(cfg.seed + i * 1000, cfg.inner_lr,
+            _MAMLWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.seed + i * 1000, cfg.inner_lr,
                                cfg.gamma, cfg.episodes_per_task)
             for i in range(cfg.num_rollout_workers)]
         self.tasks_total = 0

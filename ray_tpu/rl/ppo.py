@@ -278,15 +278,13 @@ class PPOTrainer:
         from ray_tpu.rl.connectors import build_pipeline
 
         self.cfg = config
-        from ray_tpu.rl.core import make_env
+        from ray_tpu.rl.core import probe_connected_spec
 
-        probe = make_env(config.env, config.env_config)
-        obs0, _ = probe.reset(seed=config.seed)
-        n_actions = int(probe.action_space.n)
-        probe.close()
         # obs shape AFTER the connector pipeline (e.g. FrameStack widens it)
+        obs_shape, n_actions = probe_connected_spec(
+            config.env, config.env_config, config.obs_connectors,
+            config.seed)
         self.pipeline = build_pipeline(config.obs_connectors)
-        obs_shape = self.pipeline(np.asarray(obs0, np.float32)).shape
         self.params = init_any_policy(
             jax.random.PRNGKey(config.seed), obs_shape, n_actions, config)
         self.opt = optax.adam(config.lr)

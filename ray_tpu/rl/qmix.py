@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, ReplayBuffer, episode_stats_from,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, ReplayBuffer, episode_stats_from,
                              mlp_forward, mlp_init, probe_env_spec)
 from ray_tpu.rl.multi_agent import (MultiAgentEnv, make_multi_agent_env,
                                     register_multi_agent_env)
@@ -228,7 +228,7 @@ class QMIXTrainer(Algorithm):
         self.opt_state = self.opt.init(self.nets)
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _QMIXWorker.remote(cfg.env, cfg.env_config,
+            _QMIXWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.env, cfg.env_config,
                                cfg.seed + i * 1000)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

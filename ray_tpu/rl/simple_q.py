@@ -15,7 +15,7 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import Algorithm, EnvSampler, episode_stats_from
+from ray_tpu.rl.core import Algorithm, CPU_WORKER_ENV, EnvSampler, episode_stats_from
 from ray_tpu.rl.dqn import DQNConfig, DQNTrainer
 
 
@@ -61,7 +61,7 @@ class RandomAgentTrainer(Algorithm):
 
     def _setup(self, cfg: RandomAgentConfig):
         self.workers = [
-            _RandomWorker.remote(cfg.env, cfg.seed + i * 1000,
+            _RandomWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.env, cfg.seed + i * 1000,
                                  cfg.env_config or {})
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0

@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.core import (Algorithm, ReplayBuffer, episode_stats_from,
+from ray_tpu.rl.core import (CPU_WORKER_ENV, Algorithm, ReplayBuffer, episode_stats_from,
                              mlp_forward, mlp_init)
 
 
@@ -210,7 +210,7 @@ class SlateQTrainer(Algorithm):
         self.opt_state = self.opt.init(self.net)
         self.buffer = ReplayBuffer(cfg.replay_capacity, cfg.seed)
         self.workers = [
-            _SlateWorker.remote(cfg.env_config, cfg.seed + i * 1000)
+            _SlateWorker.options(runtime_env=CPU_WORKER_ENV).remote(cfg.env_config, cfg.seed + i * 1000)
             for i in range(cfg.num_rollout_workers)]
         self.timesteps = 0
         self._since_target_sync = 0

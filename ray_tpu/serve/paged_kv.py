@@ -1,0 +1,67 @@
+"""Host-side page allocator for the paged KV cache.
+
+The device side (pools + kernel) is ops/paged_attention.py +
+llama.decode_step_paged; this is the bookkeeping half: a free list of
+physical pages and the per-slot page tables (ref: vLLM's BlockAllocator
+/ BlockTable split, re-shaped so the device arrays stay static — the
+table is a dense [slots, max_pages] int32 the engine re-uploads only
+when membership changes).
+
+Page 0 is reserved as the TRASH page: inactive slots and padding
+positions write there, so the allocator never hands it out.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class PagePool:
+    def __init__(self, num_pages: int, page_size: int, max_slots: int,
+                 max_pages_per_slot: int):
+        assert num_pages >= 2, "need at least one real page beyond trash"
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_slot = max_pages_per_slot
+        # LIFO free list; page 0 reserved as trash
+        self.free: List[int] = list(range(num_pages - 1, 0, -1))
+        self.table = np.zeros((max_slots, max_pages_per_slot), np.int32)
+        self.owned: List[List[int]] = [[] for _ in range(max_slots)]
+
+    @property
+    def free_pages(self) -> int:
+        return len(self.free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self.free)
+
+    def pages_for(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+    def can_fit(self, tokens: int) -> bool:
+        return self.pages_for(tokens) <= len(self.free)
+
+    def grow(self, slot: int, total_tokens: int) -> bool:
+        """Ensure `slot` owns enough pages for total_tokens. Returns
+        False (allocating nothing) if the pool can't satisfy it."""
+        need = self.pages_for(total_tokens)
+        if need > self.max_pages_per_slot:
+            return False
+        extra = need - len(self.owned[slot])
+        if extra <= 0:
+            return True
+        if extra > len(self.free):
+            return False
+        for _ in range(extra):
+            p = self.free.pop()
+            self.table[slot, len(self.owned[slot])] = p
+            self.owned[slot].append(p)
+        return True
+
+    def release(self, slot: int) -> None:
+        self.free.extend(reversed(self.owned[slot]))
+        self.owned[slot] = []
+        self.table[slot] = 0
