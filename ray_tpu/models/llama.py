@@ -63,6 +63,10 @@ class LlamaConfig:
     # f32-accumulated reductions (XLA fuses the upcast into the reduce)
     # halve the largest activation's HBM traffic in both directions.
     f32_logits: bool = True
+    # Pipeline-parallel schedule for forward_pp: "gpipe" (autodiff through
+    # the forward scan) or "1f1b" (explicitly-scheduled backward with an
+    # O(M)-activation stash; parallel/pipeline.py).
+    pp_schedule: str = "gpipe"
 
     @property
     def head_dim(self) -> int:
@@ -368,7 +372,7 @@ def forward_pp(params, tokens, cfg: LlamaConfig, mesh, num_microbatches=None):
         return x
 
     stacked = stack_stages(params["layers"], pp)
-    trunk = pipeline_trunk(stage_fn, mesh, M)
+    trunk = pipeline_trunk(stage_fn, mesh, M, schedule=cfg.pp_schedule)
     x = trunk(stacked, x)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"].astype(dt)

@@ -25,13 +25,34 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def pipeline_trunk(stage_fn: Callable, mesh, num_microbatches: int):
-    """Returns trunk(stacked_params, x) -> y running the GPipe schedule.
+def pipeline_trunk(stage_fn: Callable, mesh, num_microbatches: int,
+                   schedule: str = "gpipe"):
+    """Returns trunk(stacked_params, x) -> y running the chosen schedule.
 
     stacked_params: pytree, each leaf [P_stages, ...] (sharded over 'pp').
     x: [B, ...] activations entering stage 0; y: same shape leaving the last
     stage (replicated over pp on exit).
+
+    schedule:
+      "gpipe" — forward scan differentiated by jax.grad; simple, but
+        autodiff saves every tick's full carry (activation + the whole
+        [M, ...] output bank), O(M^2) microbatch-activations per stage.
+      "1f1b"  — explicit custom-vjp schedule (Megatron-LM PipeDream-flush
+        style): the backward is a hand-written REVERSE pipeline over
+        ppermute, each stage stashing exactly its M microbatch INPUTS and
+        recomputing the stage forward inside vjp (remat). O(M)
+        activations per stage and the same (P-1)/M fill/drain bubble.
+        The trunk-level API means forward and backward remain separate
+        phases (the loss head lives outside the trunk, so a trunk cannot
+        start backward before the caller's loss runs) — the memory
+        profile, not the phase interleaving, is what "1f1b" buys here;
+        see ARCHITECTURE.md.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
+                         f"got {schedule!r}")
+    if schedule == "1f1b":
+        return _pipeline_trunk_1f1b(stage_fn, mesh, num_microbatches)
     pp = int(mesh.shape["pp"])
     M = num_microbatches
 
@@ -73,6 +94,134 @@ def pipeline_trunk(stage_fn: Callable, mesh, num_microbatches: int):
         outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
         outs = jax.lax.psum(outs, "pp")
         return outs.reshape(x.shape)
+
+    return jax.shard_map(
+        trunk_local, mesh=mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=P(),
+        axis_names={"pp"}, check_vma=False)
+
+
+def _pipeline_trunk_1f1b(stage_fn: Callable, mesh, num_microbatches: int):
+    """Explicitly-scheduled pipeline: hand-written backward (reverse
+    pipeline, reverse ppermute), per-stage input stash of exactly M
+    microbatches, stage forward recomputed inside vjp (remat)."""
+    pp = int(mesh.shape["pp"])
+    M = num_microbatches
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+    rev_perm = [(i + 1, i) for i in range(pp - 1)]
+
+    def _run_forward(params_me, stage, x):
+        """GPipe fill/drain forward that ALSO returns each stage's input
+        stash [M, mb, ...] (the residual the scheduled backward needs)."""
+        B = x.shape[0]
+        mb = B // M
+        xs = x.reshape((M, mb) + x.shape[1:])
+        ticks = M + pp - 1
+
+        def tick(carry, t):
+            act, outs, stash = carry
+            mb_idx = jnp.clip(t, 0, M - 1)
+            inp0 = jax.lax.dynamic_index_in_dim(xs, mb_idx, keepdims=False)
+            inp = jnp.where(stage == 0, inp0, act)
+            # this stage works on microbatch (t - stage)
+            slot_in = jnp.clip(t - stage, 0, M - 1)
+            valid_in = jnp.logical_and(t >= stage, t - stage < M)
+            cur_in = jax.lax.dynamic_index_in_dim(stash, slot_in,
+                                                  keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_in, inp, cur_in), slot_in, axis=0)
+            out = stage_fn(params_me, inp)
+            slot = jnp.clip(t - (pp - 1), 0, M - 1)
+            valid = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, out, cur), slot, axis=0)
+            act_next = jax.lax.ppermute(out, "pp", fwd_perm)
+            return (act_next, outs, stash), None
+
+        act0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+        outs0 = jnp.zeros_like(xs)
+        stash0 = jnp.zeros_like(xs)
+        (_, outs, stash), _ = jax.lax.scan(tick, (act0, outs0, stash0),
+                                           jnp.arange(ticks))
+        outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
+        outs = jax.lax.psum(outs, "pp")
+        return outs.reshape(x.shape), stash
+
+    @jax.custom_vjp
+    def trunk_local(params_local, x):
+        params_me = jax.tree.map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index("pp")
+        y, _ = _run_forward(params_me, stage, x)
+        return y
+
+    def trunk_fwd(params_local, x):
+        params_me = jax.tree.map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index("pp")
+        y, stash = _run_forward(params_me, stage, x)
+        return y, (params_me, stash)
+
+    def trunk_bwd(res, g):
+        params_me, stash = res
+        # stash is [M, mb, ...]: recover the trunk input shape/dtype
+        mb = stash.shape[1]
+        x_shape = (M * mb,) + stash.shape[2:]
+        x_dtype = stash.dtype
+        stage = jax.lax.axis_index("pp")
+        # the forward ends in psum(outs): under shard_map's transpose the
+        # replicated output's cotangent arrives as per-device 1/pp shares
+        # — psum reconstructs the true cotangent (without it every grad
+        # lands exactly 1/pp of the autodiff-GPipe value)
+        g = jax.lax.psum(g, "pp")
+        gs = g.reshape((M, mb) + x_shape[1:]).astype(x_dtype)
+        ticks = M + pp - 1
+
+        def btick(carry, t):
+            ct_in, dxs, dparams = carry
+            # stage p back-props microbatch (t - (pp-1-p)): the cotangent
+            # for mb m leaves the LAST stage at tick m and reaches stage
+            # p (pp-1-p) ticks later via the reverse ring
+            lag = (pp - 1) - stage
+            m = jnp.clip(t - lag, 0, M - 1)
+            valid = jnp.logical_and(t >= lag, t - lag < M)
+            g_idx = jnp.clip(t, 0, M - 1)
+            ct = jnp.where(stage == pp - 1,
+                           jax.lax.dynamic_index_in_dim(gs, g_idx,
+                                                        keepdims=False),
+                           ct_in)
+            inp = jax.lax.dynamic_index_in_dim(stash, m, keepdims=False)
+            # stage forward recomputed here (remat); vjp w.r.t. params+input
+            _, vjp_fn = jax.vjp(stage_fn, params_me, inp)
+            dp, dx = vjp_fn(ct.astype(x_dtype))
+            dparams = jax.tree.map(
+                lambda acc, d: acc + jnp.where(valid, d, 0.0).astype(acc.dtype),
+                dparams, dp)
+            cur = jax.lax.dynamic_index_in_dim(dxs, m, keepdims=False)
+            bank = jnp.logical_and(valid, stage == 0)
+            dxs = jax.lax.dynamic_update_index_in_dim(
+                dxs, jnp.where(bank, dx, cur), m, axis=0)
+            ct_next = jax.lax.ppermute(jnp.where(valid, dx, 0.0),
+                                       "pp", rev_perm)
+            return (ct_next, dxs, dparams), None
+
+        ct0 = jnp.zeros((mb,) + x_shape[1:], x_dtype)
+        dxs0 = jnp.zeros((M, mb) + x_shape[1:], x_dtype)
+        dparams0 = jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32),
+                                params_me)
+        (_, dxs, dparams), _ = jax.lax.scan(
+            btick, (ct0, dxs0, dparams0), jnp.arange(ticks))
+        # x entered replicated (in_specs P()): shard_map's transpose sums
+        # the per-device cotangents itself, so return the LOCAL
+        # contribution (real values only on stage 0, zeros elsewhere) —
+        # an explicit psum here would double-count by pp
+        dx_full = dxs.reshape(x_shape)
+        # params_local leaves are [1, ...] slices: cotangent matches
+        dparams_local = jax.tree.map(lambda d, p: d[None].astype(p.dtype),
+                                     dparams, params_me)
+        return dparams_local, dx_full
+
+    trunk_local.defvjp(trunk_fwd, trunk_bwd)
 
     return jax.shard_map(
         trunk_local, mesh=mesh,

@@ -90,6 +90,12 @@ class LLMEngine:
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"kv_layout must be 'contiguous' or 'paged', "
                              f"got {kv_layout!r}")
+        if kv_layout == "paged" and cfg.sliding_window is not None:
+            # fail HERE, not inside the server's background decode thread
+            # (where the ValueError would kill the loop and hang clients)
+            raise ValueError(
+                "kv_layout='paged' does not support sliding_window "
+                "configs; use the contiguous layout for windowed models")
         self.kv_layout = kv_layout
         if kv_layout == "paged":
             from ray_tpu.serve.paged_kv import PagePool
@@ -296,9 +302,12 @@ class LLMEngine:
         for i, r in enumerate(admit):
             tok = int(first[i])
             r.generated.append(tok)
-            r.first_token_time = now
-            self.metrics["ttft_sum"] += now - r.submit_time
-            self.metrics["ttft_count"] += 1
+            # re-admission after a recompute-preemption must not reset
+            # the client-visible TTFT or double-count the metric
+            if r.first_token_time is None:
+                r.first_token_time = now
+                self.metrics["ttft_sum"] += now - r.submit_time
+                self.metrics["ttft_count"] += 1
             self.metrics["tokens_generated"] += 1
             self._maybe_finish(r)
 

@@ -60,11 +60,11 @@ def main():
     from ray_tpu.serve.llm import LLMQueueFull, LLMServer
 
     max_slots = args.max_slots or args.concurrency
-    kw = {}
+    # admission control is layout-independent: pass the depth always
+    kw = {"max_queue_depth": args.max_queue_depth}
     if args.kv_layout == "paged":
-        kw = dict(kv_layout="paged", page_size=args.page_size,
-                  num_pages=args.num_pages,
-                  max_queue_depth=args.max_queue_depth)
+        kw.update(kv_layout="paged", page_size=args.page_size,
+                  num_pages=args.num_pages)
     server = LLMServer(preset=args.preset, max_slots=max_slots,
                        decode_block=args.decode_block, **kw)
     rtt = measure_tunnel_rtt()
