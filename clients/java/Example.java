@@ -1,0 +1,62 @@
+/**
+ * Java client walkthrough against a live gateway — the same flow as
+ * clients/perl/example.pl and cpp/examples/basic.cc, over the same wire.
+ *
+ *   javac clients/java/RayTpu.java clients/java/Example.java
+ *   java -cp clients/java Example 127.0.0.1 <port>
+ */
+
+import java.util.List;
+import java.util.Map;
+
+public class Example {
+    @SuppressWarnings("unchecked")
+    public static void main(String[] argv) throws Exception {
+        String host = argv.length > 0 ? argv[0] : "127.0.0.1";
+        int port = argv.length > 1 ? Integer.parseInt(argv[1]) : 10001;
+        try (RayTpu c = new RayTpu(host, port)) {
+            // objects
+            String ref = c.put(Map.of("x", 41));
+            Map<String, Object> val = (Map<String, Object>) c.get(ref);
+            System.out.println("put/get x=" + ((Number) val.get("x"))
+                               .longValue());
+
+            // tasks: named python functions run on cluster workers
+            String h = c.task("math:hypot", List.of(3, 4));
+            System.out.println("math:hypot(3,4) = "
+                               + ((Number) c.get(h)).doubleValue());
+
+            // refs chain between tasks without coming back to the client
+            String chained = c.task("math:floor",
+                                    List.of(RayTpu.refArg(h)));
+            System.out.println("math:floor(ref) = "
+                               + ((Number) c.get(chained)).longValue());
+
+            // wait over several in-flight tasks
+            List<String> refs = List.of(
+                c.task("math:sqrt", List.of(4)),
+                c.task("math:sqrt", List.of(9)),
+                c.task("math:sqrt", List.of(16)));
+            List<List<Object>> rw = c.waitRefs(refs, 3, 60.0);
+            System.out.println("wait: " + rw.get(0).size() + " ready "
+                               + rw.get(1).size() + " pending");
+
+            // actors: stateful named python classes
+            String counter = c.actor("collections:Counter", List.of());
+            c.get(c.call(counter, "update",
+                         List.of(Map.of("tpu", 3))));
+            List<Object> top = (List<Object>) c.get(
+                c.call(counter, "most_common", List.of()));
+            List<Object> first = (List<Object>) top.get(0);
+            System.out.println("counter: " + first.get(0) + "="
+                               + ((Number) first.get(1)).longValue());
+            c.killActor(counter);
+
+            Map<String, Object> res = c.clusterResources();
+            Object cpu = res.getOrDefault("CPU", 0);
+            System.out.println("cluster CPU: "
+                               + ((Number) cpu).doubleValue());
+            System.out.println("OK");
+        }
+    }
+}

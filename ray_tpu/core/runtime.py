@@ -2085,7 +2085,9 @@ class Runtime:
             self._task_events.append({
                 "task_id": spec.task_id.hex(), "name": spec.name,
                 "state": state, "job_id": self.job_id, "ts": time.time(),
-                "actor_id": spec.actor_id.hex() if spec.actor_id else None})
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+                # who ran it — the dashboard's per-worker timeline lanes
+                "worker": self.worker_id.hex()[:12]})
             full = len(self._task_events) >= 100
         if full:
             self.flush_task_events()

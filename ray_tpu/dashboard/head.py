@@ -13,7 +13,7 @@ import asyncio
 import json
 import os
 import time
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu.core.rpc import ClientPool
 
@@ -74,6 +74,10 @@ th{color:#8b98a5;font-weight:600}  .dead{color:#e66}  .alive{color:#7c6}
 <th>actor</th><th>class</th><th>state</th><th>name</th><th>restarts</th></tr></thead><tbody></tbody></table>
 <h3>jobs</h3><table id="jobs"><thead><tr>
 <th>job</th><th>started</th><th>ended</th></tr></thead><tbody></tbody></table>
+<h3>tasks</h3><table id="tasks"><thead><tr>
+<th>task</th><th>name</th><th>state</th><th>worker</th><th>duration</th></tr></thead><tbody></tbody></table>
+<h3>timeline <span style="color:#8b98a5;font-size:.8rem">(one lane per worker, last 60 s window of finished tasks + spans)</span></h3>
+<canvas id="tl" width="1100" height="160" style="background:#1a2129;border:1px solid #2a333d;border-radius:6px"></canvas>
 <div id="err"></div>
 <footer>raw: <a href="/api/v0/summary">summary</a> · <a href="/api/v0/nodes">nodes</a>
 · <a href="/api/v0/actors">actors</a> · <a href="/api/v0/tasks">tasks</a>
@@ -119,8 +123,44 @@ async function tick(){
       cell(job.start?new Date(job.start*1000).toLocaleTimeString():""),
       cell(job.end?new Date(job.end*1000).toLocaleTimeString():"running"));
     jb.append(tr)}
+  const tsum=await j("/api/v0/task_summary?limit=2000");
+  const tb=document.querySelector("#tasks tbody");tb.replaceChildren();
+  for(const t of tsum.tasks.slice(0,200)){const tr=document.createElement("tr");
+    const st=cell(t.state);st.className=t.state==="FINISHED"?"alive":(t.state==="FAILED"?"dead":"");
+    tr.append(cell((t.task_id||"").slice(0,12)),cell(t.name),st,
+      cell(t.worker||"-"),
+      cell(t.duration_s!=null?(t.duration_s*1000).toFixed(1)+" ms":"-"));
+    tb.append(tr)}
+  drawTimeline(tsum);
   document.getElementById("err").textContent="";
  }catch(e){document.getElementById("err").textContent=String(e)}
+}
+function drawTimeline(tsum){
+ // bars come straight from the summary rows (start/end/worker already
+ // paired server-side) + tracing spans; one lane per worker
+ const cv=document.getElementById("tl"),ctx=cv.getContext("2d");
+ ctx.clearRect(0,0,cv.width,cv.height);
+ const now=Date.now()/1000,w0=now-60;
+ const bars=[];
+ for(const ev of tsum.spans||[]){
+  if(ev.ts>w0)bars.push({lane:"span:"+String(ev.trace_id).slice(0,6),
+    t0:ev.ts,t1:ev.ts+(ev.dur||0),name:ev.name,span:true})}
+ for(const t of tsum.tasks||[]){
+  if(t.start_ts!=null&&t.end_ts!=null&&t.end_ts>w0)
+   bars.push({lane:t.worker||"?",t0:t.start_ts,t1:t.end_ts,
+     name:t.name,fail:t.state==="FAILED"})}
+ const lanes=[...new Set(bars.map(b=>b.lane))].sort();
+ const lh=Math.min(26,Math.max(14,(cv.height-18)/Math.max(lanes.length,1)));
+ ctx.font="10px ui-monospace";
+ lanes.forEach((ln,i)=>{ctx.fillStyle="#8b98a5";
+   ctx.fillText(ln,4,14+i*lh)});
+ const x=(t)=>90+(t-w0)/60*(cv.width-100);
+ for(const b of bars){const i=lanes.indexOf(b.lane);
+  ctx.fillStyle=b.span?"#c9a227":(b.fail?"#e66":"#4f9d69");
+  const x0=Math.max(90,x(b.t0));
+  ctx.fillRect(x0,6+i*lh,Math.max(x(b.t1)-x0,2),lh-6)}
+ ctx.fillStyle="#8b98a5";
+ ctx.fillText("-60s",92,cv.height-4);ctx.fillText("now",cv.width-30,cv.height-4);
 }
 tick();setInterval(tick,5000);
 </script></body></html>"""
@@ -167,6 +207,39 @@ class DashboardHead:
     async def _h_tasks(self, request):
         limit = int(request.query.get("limit", 1000))
         return self._json(await self._gcs("list_task_events", limit=limit))
+
+    async def _h_task_summary(self, request):
+        """Per-task drill-down rows + tracing spans (ref: dashboard task
+        table, dashboard/modules/state/state_head.py): latest state,
+        start time, duration, worker — aggregated from the GCS
+        task-event store. One payload feeds both the UI's task table and
+        its timeline (a single GCS read per refresh tick)."""
+        limit = int(request.query.get("limit", 2000))
+        events = await self._gcs("list_task_events", limit=limit)
+        spans = [ev for ev in events if ev.get("kind") == "span"]
+        tasks: Dict[str, dict] = {}
+        # events from different processes flush independently and
+        # interleave out of order in the GCS — fold by timestamp, or a
+        # late-arriving PENDING overwrites a FINISHED forever
+        for ev in sorted((ev for ev in events if ev.get("kind") != "span"),
+                         key=lambda ev: ev["ts"]):
+            t = tasks.setdefault(ev["task_id"], {
+                "task_id": ev["task_id"], "name": ev.get("name"),
+                "actor_id": ev.get("actor_id"), "worker": None,
+                "state": None, "start_ts": None, "end_ts": None,
+                "duration_s": None})
+            t["state"] = ev.get("state")
+            if ev.get("worker"):
+                t["worker"] = ev["worker"]
+            if ev.get("state") == "RUNNING":
+                t["start_ts"] = ev["ts"]
+            elif ev.get("state") in ("FINISHED", "FAILED"):
+                t["end_ts"] = ev["ts"]
+                if t["start_ts"] is not None:
+                    t["duration_s"] = ev["ts"] - t["start_ts"]
+        out = sorted(tasks.values(),
+                     key=lambda t: t.get("start_ts") or 0, reverse=True)
+        return self._json({"tasks": out, "spans": spans})
 
     async def _h_jobs(self, request):
         return self._json(await self._gcs("list_jobs"))
@@ -417,6 +490,7 @@ class DashboardHead:
         app.router.add_get("/api/v0/nodes", self._h_nodes)
         app.router.add_get("/api/v0/actors", self._h_actors)
         app.router.add_get("/api/v0/tasks", self._h_tasks)
+        app.router.add_get("/api/v0/task_summary", self._h_task_summary)
         app.router.add_get("/api/v0/jobs", self._h_jobs)
         app.router.add_post("/api/jobs/", self._h_job_submit)
         app.router.add_get("/api/jobs/", self._h_job_list)
