@@ -74,6 +74,13 @@ class Config:
     get_timeout_warn_s: float = 10.0
     # --- workers ------------------------------------------------------------
     worker_start_timeout_s: float = 60.0
+    # A pump whose queue drained holds its lease parked for this grace
+    # window before returning it; a task submitted within the window is
+    # pushed straight to the already-leased worker — no acquire/return
+    # RPC pair (ref: worker lease reuse / idle-worker keep-alive,
+    # direct_task_transport.cc pipelining). Sequential submit->get loops
+    # go from 3 RPCs/task to 1.
+    lease_reuse_grace_s: float = 0.025
     # --- tpu ----------------------------------------------------------------
     # Logical chip resource name; slice-aware gang scheduling reserves whole
     # ICI-connected shapes (SURVEY.md section 7 "hard parts").

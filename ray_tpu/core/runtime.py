@@ -251,6 +251,11 @@ class Runtime:
         self._max_pumps = max(8, int(cfg.max_workers_per_node))
         self._class_leases: Dict[Tuple, List[_LeasedWorker]] = defaultdict(list)
         self._class_pending_lease: Dict[Tuple, int] = defaultdict(int)
+        # pumps holding a lease parked in the reuse-grace window + the
+        # event a new enqueue pulses to hand them work without a fresh
+        # lease RPC (ref: idle leased-worker reuse)
+        self._class_parked: Dict[Tuple, int] = defaultdict(int)
+        self._class_work: Dict[Tuple, asyncio.Event] = {}
         self._inflight: Dict[TaskID, _PendingTask] = {}
         # streaming-generator tasks owned here (ref: task_manager.h:143-171)
         self._streams: Dict[TaskID, _StreamState] = {}
@@ -1231,6 +1236,18 @@ class Runtime:
         # blocks its worker for the stream's whole lifetime), and gating
         # on total pump count deadlocks the still-queued siblings that
         # the consumer is waiting on.
+        parked = self._class_parked[cls]
+        if parked > 0:
+            # leased worker(s) parked in the reuse-grace window: hand them
+            # the work instead of firing fresh lease RPCs — but ONLY as
+            # far as they can absorb it; a burst deeper than the parked
+            # pool must still spawn pumps or a 100-task fan-out would
+            # serialize onto one worker
+            ev = self._class_work.get(cls)
+            if ev is not None:
+                self.loop.call_soon_threadsafe(ev.set)
+            if len(q) <= parked:
+                return
         if self._class_pending_lease[cls] < self._max_pumps:
             self._spawn(self._pump_class(cls))
 
@@ -1284,7 +1301,26 @@ class Runtime:
                 try:
                     spec = q.popleft()
                 except IndexError:
-                    break
+                    # queue drained: park the lease for the reuse-grace
+                    # window — a submit landing in it rides this worker
+                    # with zero lease RPCs (ref: idle leased-worker reuse)
+                    grace = self.cfg.lease_reuse_grace_s
+                    if grace <= 0 or self._shutdown:
+                        break
+                    ev = self._class_work.get(cls)
+                    if ev is None:
+                        ev = self._class_work[cls] = asyncio.Event()
+                    ev.clear()
+                    if q:        # landed between drain and clear
+                        continue
+                    self._class_parked[cls] += 1
+                    try:
+                        await asyncio.wait_for(ev.wait(), grace)
+                    except asyncio.TimeoutError:
+                        break
+                    finally:
+                        self._class_parked[cls] -= 1
+                    continue
                 if not await self._push_and_handle(spec, lw, cls):
                     break     # worker died; retries repump on a fresh lease
         finally:
@@ -1446,7 +1482,7 @@ class Runtime:
         """Push one task to a leased worker. Returns False when the worker
         is dead (the caller must abandon this lease; retries are re-enqueued
         and repumped onto a fresh lease)."""
-        self._record_event(spec, "RUNNING")
+        self._record_event(spec, "RUNNING", worker=lw.worker_id.hex()[:12])
         try:
             result: TaskResult = await self.pool.get(lw.worker_addr).call(
                 "push_task", spec=spec)
@@ -1463,10 +1499,12 @@ class Runtime:
                 self._fail_task_returns(spec, WorkerCrashedError(
                     f"worker died running {spec.name}: {e}"))
             return False
-        self._complete_task(spec, result, cls)
+        self._complete_task(spec, result, cls,
+                            worker=lw.worker_id.hex()[:12])
         return True
 
-    def _complete_task(self, spec: TaskSpec, result: TaskResult, cls: Optional[Tuple]):
+    def _complete_task(self, spec: TaskSpec, result: TaskResult,
+                       cls: Optional[Tuple], worker: Optional[str] = None):
         app_error = None
         for kind, payload in result.returns:
             if kind == "err":
@@ -1505,7 +1543,8 @@ class Runtime:
             if e.state != "error":
                 e.state = "ready"
             self._complete_entry(e)
-        self._record_event(spec, "FAILED" if app_error else "FINISHED")
+        self._record_event(spec, "FAILED" if app_error else "FINISHED",
+                           worker=worker)
         self._inflight.pop(spec.task_id, None)
         arg_ids = [p[0] for (k, p) in spec.args if k == "ref"]
         self.refs.on_task_done(arg_ids)
@@ -2079,15 +2118,17 @@ class Runtime:
 
     # -------------------------------------------------------------- telemetry
 
-    def _record_event(self, spec: TaskSpec, state: str):
+    def _record_event(self, spec: TaskSpec, state: str,
+                      worker: Optional[str] = None):
         """ref: task_event_buffer.h:199 — bounded buffer, flushed to GCS."""
         with self._task_events_lock:
             self._task_events.append({
                 "task_id": spec.task_id.hex(), "name": spec.name,
                 "state": state, "job_id": self.job_id, "ts": time.time(),
                 "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-                # who ran it — the dashboard's per-worker timeline lanes
-                "worker": self.worker_id.hex()[:12]})
+                # the EXECUTING worker (None on owner-side PENDING events)
+                # — the dashboard's per-worker timeline lanes
+                "worker": worker})
             full = len(self._task_events) >= 100
         if full:
             self.flush_task_events()

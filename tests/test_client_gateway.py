@@ -196,13 +196,18 @@ def test_java_client_end_to_end(gateway):
 
     if not (shutil.which("javac") and shutil.which("java")):
         pytest.skip("no JVM in image (clients/java compiles where one exists)")
+    import tempfile
+
     jdir = os.path.join(REPO, "clients", "java")
-    subprocess.run(["javac", os.path.join(jdir, "RayTpu.java"),
-                    os.path.join(jdir, "Example.java")],
-                   check=True, capture_output=True, timeout=120)
-    out = subprocess.run(
-        ["java", "-cp", jdir, "Example", "127.0.0.1", str(gateway.port)],
-        check=True, capture_output=True, text=True, timeout=120).stdout
+    with tempfile.TemporaryDirectory() as build:
+        subprocess.run(["javac", "-d", build,
+                        os.path.join(jdir, "RayTpu.java"),
+                        os.path.join(jdir, "Example.java")],
+                       check=True, capture_output=True, timeout=120)
+        out = subprocess.run(
+            ["java", "-cp", build, "Example", "127.0.0.1",
+             str(gateway.port)],
+            check=True, capture_output=True, text=True, timeout=120).stdout
     assert "put/get x=41" in out
     assert "math:hypot(3,4) = 5" in out
     assert "math:floor(ref) = 5" in out

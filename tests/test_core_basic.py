@@ -163,3 +163,34 @@ def test_resource_accounting_no_leak_under_churn(ray_start_regular):
             break
         time.sleep(0.2)
     assert ray_tpu.available_resources().get("CPU") == 4.0
+
+
+def test_lease_reuse_grace_window(ray_start_regular):
+    """A sequential submit->get loop must ride ONE parked lease instead
+    of an acquire/return RPC pair per task (lease_reuse_grace_s; ref:
+    idle leased-worker reuse). Regression: r2 paid ~3 lease RPCs/task."""
+    from ray_tpu import _rt
+
+    rt = _rt.get_runtime()
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def f(x):
+        return x
+
+    assert ray_tpu.get(f.remote(0)) == 0       # warm worker + function
+
+    calls = {"n": 0}
+    orig = rt._acquire_lease
+
+    async def counting(*a, **k):
+        calls["n"] += 1
+        return await orig(*a, **k)
+
+    rt._acquire_lease = counting
+    try:
+        for i in range(20):
+            assert ray_tpu.get(f.remote(i)) == i
+    finally:
+        rt._acquire_lease = orig
+    # the whole loop should fit in a handful of leases, not one per task
+    assert calls["n"] <= 5, calls["n"]

@@ -185,9 +185,11 @@ def test_task_summary_and_timeline(dash):
     done = [r for r in payload["tasks"] if r["name"] == "work"
             and r["state"] == "FINISHED"]
     assert len(done) >= 3
+    driver_id = ray_tpu._rt.get_runtime().worker_id.hex()[:12]
     for r in done[:3]:
         assert r["duration_s"] is not None and r["duration_s"] >= 0.04
-        assert r["worker"], r
+        # the EXECUTING worker, not the submitting driver
+        assert r["worker"] and r["worker"] != driver_id, r
 
     _, _, body = _get(dash + "/")
     html = body if isinstance(body, str) else body.decode()
