@@ -1776,7 +1776,10 @@ class Runtime:
         except (ConnectionLost, RemoteError, OSError) as e:
             await self._on_actor_push_failure(spec, retries, addr, e)
             return
-        self._complete_task(spec, result, None)
+        # actor path has no lease record: the worker's address is its
+        # stable identity for the dashboard's per-worker lanes
+        self._complete_task(spec, result, None,
+                            worker=f"{addr[0]}:{addr[1]}")
 
     async def _on_actor_push_failure(self, spec: TaskSpec, retries: int,
                                      addr: Address, err: Exception):

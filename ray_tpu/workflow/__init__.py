@@ -17,8 +17,11 @@ from completed steps instead of recomputing.
     # re-running with the same workflow_id skips completed steps
 """
 
-from ray_tpu.workflow.api import (StepNode, get_status, list_all, resume,
-                                  run, run_async, step)
+from ray_tpu.workflow.api import (EventNode, StepNode, get_status,
+                                  list_all, resume, run, run_async,
+                                  send_event, set_max_running, step,
+                                  wait_for_event)
 
 __all__ = ["step", "run", "run_async", "resume", "get_status",
-           "list_all", "StepNode"]
+           "list_all", "StepNode", "EventNode", "wait_for_event",
+           "send_event", "set_max_running"]

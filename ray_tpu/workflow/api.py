@@ -16,6 +16,14 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 
 
+def _atomic_pickle(path: str, obj: Any) -> None:
+    """Write-then-rename so readers never observe a torn checkpoint."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f)
+    os.replace(tmp, path)
+
+
 def _content_bytes(a: Any) -> bytes:
     """Stable content bytes of a step arg. Plain pickle first; callables
     and anything else plain pickle rejects (lambdas, __main__ closures)
@@ -80,6 +88,65 @@ def step(fn=None, *, max_retries: int = 3):
     return lambda f: _Step(f, max_retries)
 
 
+class EventNode(StepNode):
+    """A durable external-event wait (ref: workflow.wait_for_event +
+    event_listener.py). Resolution blocks until send_event() delivers a
+    payload for (workflow_id, name); the payload checkpoints like any
+    step result, so a resumed workflow does NOT re-wait for an event it
+    already received."""
+
+    def __init__(self, name: str, timeout: Optional[float] = None,
+                 poll_interval: float = 0.05):
+        super().__init__(fn=None, args=(), kwargs={}, name=f"event:{name}")
+        self.event_name = name
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+
+    def key(self) -> str:
+        return f"event-{self.event_name}"
+
+
+def wait_for_event(name: str, timeout: Optional[float] = None) -> EventNode:
+    """Use as a step argument (or continuation target): the workflow
+    parks until `send_event(workflow_id, name, payload)` fires, then the
+    payload flows into the dependent step."""
+    return EventNode(name, timeout)
+
+
+def _event_path(storage: str, workflow_id: str, name: str) -> str:
+    d = os.path.join(storage, workflow_id, "events")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, name + ".pkl")
+
+
+def send_event(workflow_id: str, name: str, payload: Any = None, *,
+               storage: str) -> None:
+    """Deliver an external event (ref: workflow event HTTP endpoint /
+    manual event senders). Durable: the payload lands on storage first,
+    so a crash between send and receipt re-delivers on resume."""
+    _atomic_pickle(_event_path(storage, workflow_id, name), payload)
+
+
+# ---- workflow queue (ref: max running workflows + QUEUED status) ----------
+
+import threading as _threading
+
+_queue_sem = None
+#: thread-local handle to the queue slot the current workflow holds, so
+#: event waits can release it while parked
+_slot_ctx = _threading.local()
+
+
+def set_max_running(n: Optional[int]) -> None:
+    """Cap concurrently RUNNING workflows started via run_async; excess
+    submissions hold in QUEUED status until a slot frees (ref: the
+    reference's workflow queue semantics). None lifts the cap."""
+    global _queue_sem
+    import threading
+
+    _queue_sem = None if n is None else threading.BoundedSemaphore(n)
+
+
 def _storage_path(storage: str, workflow_id: str, key: str) -> str:
     d = os.path.join(storage, workflow_id, "steps")
     os.makedirs(d, exist_ok=True)
@@ -115,6 +182,33 @@ def run(node: StepNode, *, workflow_id: str, storage: str) -> Any:
                 out = pickle.load(f)
             memo[key] = out
             return out
+        if isinstance(n, EventNode):
+            import time as _time
+
+            ep = _event_path(storage, workflow_id, n.event_name)
+            deadline = (None if n.timeout is None
+                        else _time.time() + n.timeout)
+            # an event wait does no work: give the queue slot back while
+            # parked, or a capped queue deadlocks when the event depends
+            # on a QUEUED workflow's output
+            sem = getattr(_slot_ctx, "sem", None)
+            if sem is not None:
+                sem.release()
+            try:
+                while not os.path.exists(ep):
+                    if deadline is not None and _time.time() > deadline:
+                        raise TimeoutError(
+                            f"workflow event {n.event_name!r} not "
+                            f"delivered within {n.timeout}s")
+                    _time.sleep(n.poll_interval)
+            finally:
+                if sem is not None:
+                    sem.acquire()
+            with open(ep, "rb") as f:
+                out = pickle.load(f)
+            _atomic_pickle(path, out)
+            memo[key] = out
+            return out
         args = [resolve(a) if isinstance(a, StepNode) else a for a in n.args]
         kwargs = {k: (resolve(v) if isinstance(v, StepNode) else v)
                   for k, v in n.kwargs.items()}
@@ -122,10 +216,7 @@ def run(node: StepNode, *, workflow_id: str, storage: str) -> Any:
         out = ray_tpu.get(task.remote(*args, **kwargs))
         while isinstance(out, StepNode):   # continuation
             out = resolve(out)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(out, f)
-        os.replace(tmp, path)
+        _atomic_pickle(path, out)
         memo[key] = out
         return out
 
@@ -147,13 +238,30 @@ def run_async(node: StepNode, *, workflow_id: str, storage: str):
     from concurrent.futures import Future
 
     fut: Future = Future()
+    sem = _queue_sem
 
     def work():
-        if not fut.set_running_or_notify_cancel():
-            return    # cancelled before the workflow started
         try:
-            fut.set_result(run(node, workflow_id=workflow_id,
-                               storage=storage))
+            if sem is not None:
+                _write_status(storage, workflow_id, "QUEUED")
+                sem.acquire()
+            # transition to RUNNING only after the slot is held: a QUEUED
+            # workflow stays cancel()-able for its whole queue wait
+            if not fut.set_running_or_notify_cancel():
+                if sem is not None:
+                    sem.release()
+                _write_status(storage, workflow_id, "CANCELLED")
+                return
+            try:
+                _slot_ctx.sem = sem
+                try:
+                    fut.set_result(run(node, workflow_id=workflow_id,
+                                       storage=storage))
+                finally:
+                    _slot_ctx.sem = None
+            finally:
+                if sem is not None:
+                    sem.release()
         except BaseException as e:
             fut.set_exception(e)
 

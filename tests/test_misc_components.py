@@ -326,3 +326,77 @@ def test_workflow_continuation_and_status(ray_start_regular, tmp_path):
     assert workflow.resume(fib.bind(8), workflow_id="fib8",
                            storage=storage) == 21
     assert workflow.get_status("nope", storage=storage) == "NOT_FOUND"
+
+
+def test_workflow_events(ray_start_regular, tmp_path):
+    """wait_for_event parks the workflow until send_event delivers a
+    payload; the receipt checkpoints, so resume does not re-wait
+    (VERDICT r2 missing #6 / ref workflow wait_for_event)."""
+    import time
+
+    from ray_tpu import workflow
+
+    @workflow.step
+    def handle(approval):
+        return f"approved by {approval['who']}"
+
+    dag = handle.bind(workflow.wait_for_event("approval"))
+    fut = workflow.run_async(dag, workflow_id="wfe", storage=str(tmp_path))
+    time.sleep(0.3)
+    assert not fut.done()                   # parked on the event
+    assert workflow.get_status("wfe", storage=str(tmp_path)) == "RUNNING"
+    workflow.send_event("wfe", "approval", {"who": "ops"},
+                        storage=str(tmp_path))
+    assert fut.result(timeout=30) == "approved by ops"
+    # resume: the event is checkpointed — no new send needed, instant
+    out = workflow.run(dag, workflow_id="wfe", storage=str(tmp_path))
+    assert out == "approved by ops"
+
+
+def test_workflow_event_timeout(ray_start_regular, tmp_path):
+    import pytest as _pytest
+
+    from ray_tpu import workflow
+
+    @workflow.step
+    def use(x):
+        return x
+
+    dag = use.bind(workflow.wait_for_event("never", timeout=0.3))
+    with _pytest.raises(TimeoutError, match="never"):
+        workflow.run(dag, workflow_id="wft", storage=str(tmp_path))
+    assert workflow.get_status("wft", storage=str(tmp_path)) == "FAILED"
+
+
+def test_workflow_queue_max_running(ray_start_regular, tmp_path):
+    """set_max_running(1): the second workflow holds in QUEUED until the
+    first finishes (ref: workflow queue semantics)."""
+    import time
+
+    from ray_tpu import workflow
+
+    @workflow.step
+    def slow():
+        import time as t
+        t.sleep(1.0)
+        return "a"
+
+    @workflow.step
+    def fast():
+        return "b"
+
+    workflow.set_max_running(1)
+    try:
+        f1 = workflow.run_async(slow.bind(), workflow_id="q1",
+                                storage=str(tmp_path))
+        time.sleep(0.3)
+        f2 = workflow.run_async(fast.bind(), workflow_id="q2",
+                                storage=str(tmp_path))
+        time.sleep(0.3)
+        assert workflow.get_status("q2", storage=str(tmp_path)) == "QUEUED"
+        assert f1.result(timeout=60) == "a"
+        assert f2.result(timeout=60) == "b"
+        assert workflow.get_status("q2",
+                                   storage=str(tmp_path)) == "SUCCESSFUL"
+    finally:
+        workflow.set_max_running(None)
