@@ -34,6 +34,12 @@ class ScalingConfig:
 @dataclass
 class FailureConfig:
     max_failures: int = 0                 # group restarts from last checkpoint
+    # Hang watchdog (SURVEY §7 hard parts: "a single hung chip stalls a
+    # whole pjit program; need watchdogs + slice restart"): if no worker
+    # reports progress for this many seconds mid-run, the group is killed
+    # and restarted from the last checkpoint like a crash. None = off.
+    # Must exceed the slowest expected step INCLUDING first-step compile.
+    hang_timeout_s: Optional[float] = None
 
 
 @dataclass

@@ -114,17 +114,32 @@ class JaxTrainer:
             run_refs = [w.run.remote(self.loop, self.config)
                         for w in group.workers]
             seen = 0
+            hang_timeout = self.run_config.failure_config.hang_timeout_s
+            last_progress = time.time()
             while True:
                 poll = ray_tpu.get(group.workers[0].poll.remote(seen))
                 for r in poll["reports"]:
                     result.metrics_history.append(r)
                     result.metrics = r
+                if poll["reports"]:
+                    last_progress = time.time()
                 seen += len(poll["reports"])
                 if poll["error"]:
                     result.error = poll["error"]
                     break
                 if poll["finished"]:
                     break
+                if (hang_timeout is not None
+                        and time.time() - last_progress > hang_timeout):
+                    # stuck pjit program: a live-but-hung worker never
+                    # raises, so the death-based retry path would wait
+                    # forever — kill the group and surface a crash so
+                    # fit()'s restart-from-checkpoint loop takes over
+                    group.shutdown()
+                    raise ray_tpu.exceptions.WorkerCrashedError(
+                        f"train hang watchdog: no progress report for "
+                        f"{hang_timeout}s (SURVEY hung-chip semantics: "
+                        f"the group restarts from the last checkpoint)")
                 ready, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs),
                                         timeout=0.25)
                 if len(ready) == len(run_refs):
