@@ -83,7 +83,7 @@ class _Lib:
                 lib.ts_xfer_serve_start.restype = ctypes.c_int
                 lib.ts_xfer_serve_start.argtypes = [
                     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
-                lib.ts_xfer_serve_stop.restype = None
+                lib.ts_xfer_serve_stop.restype = ctypes.c_int
                 lib.ts_xfer_serve_stop.argtypes = []
                 lib.ts_xfer_fetch.restype = ctypes.c_int
                 lib.ts_xfer_fetch.argtypes = [
@@ -270,8 +270,15 @@ class SharedMemoryStore:
         RPC path)."""
         return int(self._lib.ts_xfer_serve_start(self._h, host.encode(), 0))
 
-    def xfer_serve_stop(self) -> None:
-        self._lib.ts_xfer_serve_stop()
+    def xfer_serve_stop(self) -> int:
+        """Stop the transfer server, draining in-flight sender threads.
+        Returns the count of threads still live after the drain window
+        (0 = fully drained). Nonzero poisons close(): the segment must
+        not be munmapped under a wedged sender thread."""
+        leftover = int(self._lib.ts_xfer_serve_stop())
+        if leftover:
+            self._xfer_undrained = True
+        return leftover
 
     def xfer_fetch(self, host: str, port: int,
                    oid: ObjectID) -> "tuple[int, int]":
@@ -303,6 +310,8 @@ class SharedMemoryStore:
         for process exit while native transfer threads may still touch
         the segment (the mapping dies with the process; munmapping under
         a live xfer.cc thread would SIGSEGV it mid-transfer)."""
+        if getattr(self, "_xfer_undrained", False):
+            unmap = False  # a wedged xfer thread may still touch the map
         if self._h and unmap:
             try:
                 self._view.release()

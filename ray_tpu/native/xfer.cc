@@ -36,8 +36,13 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <mutex>
 #include <thread>
+#include <vector>
 
 // Public C ABI of the store (objstore.cc, linked into the same .so).
 extern "C" {
@@ -65,6 +70,19 @@ struct ServerState {
 };
 
 ServerState g_server;
+
+// Connection registry. ts_xfer_serve_stop() MUST NOT return while any
+// sender thread can still touch the shm heap or the Store handle: the
+// caller's next move is ts_detach (munmap + delete Store), and a sender
+// still inside write_exact()/ts_release() would segfault on the unmapped
+// segment — the exact delete-race crash the round-3 suite reproduced.
+// Every handler thread (and the listener) registers here; stop() shuts
+// down all live conn fds (aborting blocked reads/writes immediately) and
+// drains the registry before returning.
+std::mutex g_conn_mu;
+std::condition_variable g_conn_cv;
+std::vector<int> g_conn_fds;  // fds whose handler thread is still live
+int g_live_threads = 0;       // handler threads + listener thread
 
 void set_timeouts(int fd) {
   struct timeval tv;
@@ -127,6 +145,25 @@ void handle_conn(int fd, void* store) {
     ts_release(store, id);
     if (!ok) break;
   }
+}
+
+// Thread body for one accepted connection: run the handler, then
+// deregister BEFORE closing the fd — serve_stop shuts down registered
+// fds under g_conn_mu, so the fd number can never be recycled while
+// still in the registry.
+void conn_main(int fd, void* store) {
+  handle_conn(fd, store);
+  {
+    // notify INSIDE the critical section: once a waiter observes
+    // g_live_threads == 0 under the mutex, this thread is provably past
+    // its last cv touch — the process may exit and destroy the cv
+    // without racing the broadcast. (The fd stays ours until close(), so
+    // its number cannot be recycled into the registry meanwhile.)
+    std::lock_guard<std::mutex> g(g_conn_mu);
+    g_conn_fds.erase(std::find(g_conn_fds.begin(), g_conn_fds.end(), fd));
+    g_live_threads--;
+    g_conn_cv.notify_all();
+  }
   close(fd);
 }
 
@@ -164,6 +201,10 @@ int ts_xfer_serve_start(void* store, const char* host, int port) {
   g_server.stop.store(false);
   uint64_t gen = g_server.generation.fetch_add(1) + 1;
 
+  {
+    std::lock_guard<std::mutex> g(g_conn_mu);
+    g_live_threads++;  // the listener itself
+  }
   std::thread([fd, store, gen]() {
     while (!g_server.stop.load() && g_server.generation.load() == gen) {
       int conn = accept(fd, nullptr, nullptr);
@@ -173,30 +214,54 @@ int ts_xfer_serve_start(void* store, const char* host, int port) {
         if (errno == EINTR || errno == ECONNABORTED) continue;
         if (errno == EBADF || errno == EINVAL) break;  // fd closed
         usleep(10000);                  // EMFILE etc.: back off, don't spin
-      } else if (g_server.stop.load() ||
-                 g_server.generation.load() != gen) {
-        // stale thread raced a restart and won accept() on a REUSED fd
-        // number: this connection belongs to the new server's socket but
-        // our captured store pointer is stale — drop it, the client
-        // retries and lands on the live listener
-        close(conn);
-        break;
       } else {
-        std::thread(handle_conn, conn, store).detach();
+        // Register under the lock, re-checking stop/generation there:
+        // serve_stop iterates the registry under the same lock, so a
+        // handler can neither be spawned after the drain snapshot nor
+        // missed by it. (The stale-generation case also lands here: a
+        // stale thread that won accept() on a REUSED fd number holds a
+        // connection meant for the new server — drop it, the client
+        // retries and lands on the live listener.)
+        std::lock_guard<std::mutex> g(g_conn_mu);
+        if (g_server.stop.load() || g_server.generation.load() != gen) {
+          close(conn);
+          break;
+        }
+        g_conn_fds.push_back(conn);
+        g_live_threads++;
+        std::thread(conn_main, conn, store).detach();
       }
+    }
+    {
+      std::lock_guard<std::mutex> g(g_conn_mu);
+      g_live_threads--;
+      g_conn_cv.notify_all();  // inside the lock: see conn_main
     }
   }).detach();
   return (int)ntohs(addr.sin_port);
 }
 
-void ts_xfer_serve_stop() {
-  if (g_server.listen_fd < 0) return;
+// Stop the server and drain every live handler thread. Returns the
+// number of threads still live after the drain window — 0 means fully
+// drained and the caller may munmap/detach the store; nonzero means a
+// handler is wedged (e.g. blocked on the robust store mutex held by a
+// crashed peer) and the caller MUST NOT unmap the segment or detach the
+// handle, or the wedged thread's next touch is the round-3 SIGSEGV.
+int ts_xfer_serve_stop() {
+  if (g_server.listen_fd < 0) return 0;
   g_server.stop.store(true);
   g_server.generation.fetch_add(1);  // invalidate the listener thread
   // shutdown unblocks accept() reliably; close alone may not
   shutdown(g_server.listen_fd, SHUT_RDWR);
   close(g_server.listen_fd);
   g_server.listen_fd = -1;
+  // Drain: shutdown() aborts any blocked socket read()/write()
+  // immediately, and the registry empties as the threads deregister.
+  std::unique_lock<std::mutex> lk(g_conn_mu);
+  for (int cfd : g_conn_fds) shutdown(cfd, SHUT_RDWR);
+  g_conn_cv.wait_for(lk, std::chrono::seconds(10),
+                     [] { return g_live_threads == 0; });
+  return g_live_threads;
 }
 
 // Fetch one object from a remote transfer server into the local store.

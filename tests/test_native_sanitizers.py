@@ -131,6 +131,82 @@ lib.ts_destroy(seg.encode())
 sys.exit(0)''')
 
 
+# Delete-during-native-send driver: the round-3 segfault path. Fetch
+# threads pull objects through the xfer TCP plane while the source
+# deletes them mid-send, then serve_stop + detach immediately — if stop
+# returns before the detached sender threads drain, ts_detach's munmap +
+# `delete Store` turns the senders' next heap/handle touch into a
+# use-after-free the sanitizer reports (and a SIGSEGV in production).
+XFER_DRIVER = r"""
+import ctypes, os, sys, threading
+
+so, seg, iters = sys.argv[1], sys.argv[2], int(sys.argv[3])
+lib = ctypes.CDLL(so)
+lib.ts_create.restype = ctypes.c_void_p
+lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+lib.ts_detach.argtypes = [ctypes.c_void_p]
+lib.ts_destroy.argtypes = [ctypes.c_char_p]
+lib.ts_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                       ctypes.c_uint64]
+lib.ts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+lib.ts_get.restype = ctypes.c_uint64
+lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                       ctypes.POINTER(ctypes.c_uint64)]
+lib.ts_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+lib.ts_seg_base.restype = ctypes.c_void_p
+lib.ts_seg_base.argtypes = [ctypes.c_void_p]
+lib.ts_xfer_serve_start.restype = ctypes.c_int
+lib.ts_xfer_serve_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+lib.ts_xfer_serve_stop.restype = None
+lib.ts_xfer_serve_stop.argtypes = []
+lib.ts_xfer_fetch.restype = ctypes.c_int
+lib.ts_xfer_fetch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_int, ctypes.c_char_p,
+                              ctypes.POINTER(ctypes.c_uint64)]
+
+payload = bytes(range(256)) * (16 << 10)   # 4 MiB: sends span many write()s
+for it in range(iters):
+    a = lib.ts_create((seg + "_a").encode(), 32 << 20, 256)
+    b = lib.ts_create((seg + "_b").encode(), 32 << 20, 256)
+    assert a and b, "create failed"
+    port = lib.ts_xfer_serve_start(a, b"127.0.0.1", 0)
+    assert port > 0, "serve start failed"
+    oids = [bytes([it & 0xFF, i]) + b"q" * 18 for i in range(4)]
+    for o in oids:
+        lib.ts_put(a, o, payload, len(payload))
+    rcs = {}
+    def fetch(o):
+        total = ctypes.c_uint64()
+        rcs[o] = lib.ts_xfer_fetch(b, b"127.0.0.1", port, o,
+                                   ctypes.byref(total))
+    ts = [threading.Thread(target=fetch, args=(o,)) for o in oids]
+    for t in ts:
+        t.start()
+    for o in oids:
+        lib.ts_delete(a, o)            # races every in-flight send
+    for t in ts:
+        t.join()
+    for o in oids:
+        rc = rcs[o]
+        assert rc in (0, 1), f"iter {it}: bad rc {rc}"
+        if rc == 0:
+            sz = ctypes.c_uint64()
+            off = lib.ts_get(b, o, ctypes.byref(sz))
+            assert off and sz.value == len(payload), f"iter {it}: bad size"
+            got = ctypes.string_at(lib.ts_seg_base(b) + off, sz.value)
+            assert got == payload, f"iter {it}: corrupt payload"
+            lib.ts_release(b, o)
+    # the round-3 crash window: stop must drain senders BEFORE detach
+    lib.ts_xfer_serve_stop()
+    lib.ts_detach(a)
+    lib.ts_detach(b)
+    lib.ts_destroy((seg + "_a").encode())
+    lib.ts_destroy((seg + "_b").encode())
+sys.exit(0)
+"""
+
+
 def _sanitizer_lib(name: str):
     out = subprocess.run(["g++", f"-print-file-name=lib{name}.so"],
                          capture_output=True, text=True)
@@ -146,8 +222,10 @@ def _build(tmp: str, flag: str) -> str:
     return so
 
 
-def _run(driver: str, so: str, preload: str, seg: str, nproc: int,
+def _run(driver: str, so: str, preload: str, seg: str, driver_arg: int,
          extra_env=None):
+    # driver_arg is DRIVER-SPECIFIC: process/thread count for the churn
+    # drivers, iteration count for XFER_DRIVER.
     env = dict(os.environ)
     env["LD_PRELOAD"] = preload
     # route Python allocations through malloc so the sanitizer sees the
@@ -161,7 +239,7 @@ def _run(driver: str, so: str, preload: str, seg: str, nproc: int,
         script = f.name
     try:
         return subprocess.run(
-            [sys.executable, script, so, seg, str(nproc)],
+            [sys.executable, script, so, seg, str(driver_arg)],
             env=env, capture_output=True, text=True, timeout=600)
     finally:
         os.unlink(script)
@@ -172,7 +250,7 @@ def _run(driver: str, so: str, preload: str, seg: str, nproc: int,
 def test_objstore_asan_clean(tmp_path):
     so = _build(str(tmp_path), "-fsanitize=address")
     res = _run(DRIVER, so, _sanitizer_lib("asan"),
-               f"rtx_asan_{os.getpid()}", nproc=2,
+               f"rtx_asan_{os.getpid()}", driver_arg=2,
                extra_env={"ASAN_OPTIONS":
                           "detect_leaks=0:abort_on_error=1"})
     assert res.returncode == 0, \
@@ -184,7 +262,30 @@ def test_objstore_asan_clean(tmp_path):
 def test_objstore_tsan_clean(tmp_path):
     so = _build(str(tmp_path), "-fsanitize=thread")
     res = _run(DRIVER_THREADS, so, _sanitizer_lib("tsan"),
-               f"rtx_tsan_{os.getpid()}", nproc=3,
+               f"rtx_tsan_{os.getpid()}", driver_arg=3,
+               extra_env={"TSAN_OPTIONS": "halt_on_error=1"})
+    assert res.returncode == 0, \
+        f"TSAN findings:\n{res.stderr[-4000:]}\n{res.stdout[-1000:]}"
+
+
+@pytest.mark.skipif(_sanitizer_lib("asan") is None,
+                    reason="libasan not available")
+def test_xfer_delete_race_asan_clean(tmp_path):
+    so = _build(str(tmp_path), "-fsanitize=address")
+    res = _run(XFER_DRIVER, so, _sanitizer_lib("asan"),
+               f"rtx_xasan_{os.getpid()}", driver_arg=8,
+               extra_env={"ASAN_OPTIONS":
+                          "detect_leaks=0:abort_on_error=1"})
+    assert res.returncode == 0, \
+        f"ASAN findings:\n{res.stderr[-4000:]}\n{res.stdout[-1000:]}"
+
+
+@pytest.mark.skipif(_sanitizer_lib("tsan") is None,
+                    reason="libtsan not available")
+def test_xfer_delete_race_tsan_clean(tmp_path):
+    so = _build(str(tmp_path), "-fsanitize=thread")
+    res = _run(XFER_DRIVER, so, _sanitizer_lib("tsan"),
+               f"rtx_xtsan_{os.getpid()}", driver_arg=8,
                extra_env={"TSAN_OPTIONS": "halt_on_error=1"})
     assert res.returncode == 0, \
         f"TSAN findings:\n{res.stderr[-4000:]}\n{res.stdout[-1000:]}"
