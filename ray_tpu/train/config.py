@@ -38,8 +38,16 @@ class FailureConfig:
     # whole pjit program; need watchdogs + slice restart"): if no worker
     # reports progress for this many seconds mid-run, the group is killed
     # and restarted from the last checkpoint like a crash. None = off.
-    # Must exceed the slowest expected step INCLUDING first-step compile.
+    # Only the gap BETWEEN reports is policed: before an attempt's first
+    # report the worker is still cold-starting (process spawn, jax
+    # import, first-step compile), covered by startup_grace_s below.
     hang_timeout_s: Optional[float] = None
+    # Grace window for an attempt's FIRST progress report. Restarted
+    # attempts pay the full cold start again, so without this a
+    # hang_timeout_s tuned to steady-state step time re-trips the
+    # watchdog during every restart's spawn + jax import + compile.
+    # The effective first-report deadline is max(hang, grace).
+    startup_grace_s: float = 120.0
 
 
 @dataclass

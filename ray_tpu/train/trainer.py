@@ -115,7 +115,9 @@ class JaxTrainer:
                         for w in group.workers]
             seen = 0
             hang_timeout = self.run_config.failure_config.hang_timeout_s
+            startup_grace = self.run_config.failure_config.startup_grace_s
             last_progress = time.time()
+            got_report = False
             while True:
                 poll = ray_tpu.get(group.workers[0].poll.remote(seen))
                 for r in poll["reports"]:
@@ -123,22 +125,31 @@ class JaxTrainer:
                     result.metrics = r
                 if poll["reports"]:
                     last_progress = time.time()
+                    got_report = True
                 seen += len(poll["reports"])
                 if poll["error"]:
                     result.error = poll["error"]
                     break
                 if poll["finished"]:
                     break
+                # The no-progress clock effectively starts at the first
+                # report: until then the worker is cold-starting (spawn +
+                # jax import + first compile — repeated in full by every
+                # restarted attempt), so the deadline is the startup
+                # grace, not the steady-state report gap.
+                limit = (hang_timeout if got_report
+                         else max(hang_timeout or 0.0, startup_grace))
                 if (hang_timeout is not None
-                        and time.time() - last_progress > hang_timeout):
+                        and time.time() - last_progress > limit):
                     # stuck pjit program: a live-but-hung worker never
                     # raises, so the death-based retry path would wait
                     # forever — kill the group and surface a crash so
                     # fit()'s restart-from-checkpoint loop takes over
                     group.shutdown()
                     raise ray_tpu.exceptions.WorkerCrashedError(
-                        f"train hang watchdog: no progress report for "
-                        f"{hang_timeout}s (SURVEY hung-chip semantics: "
+                        f"train hang watchdog: no "
+                        f"{'progress report' if got_report else 'first report'}"
+                        f" for {limit}s (SURVEY hung-chip semantics: "
                         f"the group restarts from the last checkpoint)")
                 ready, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs),
                                         timeout=0.25)
