@@ -45,8 +45,10 @@ def pipeline_trunk(stage_fn: Callable, mesh, num_microbatches: int,
         The trunk-level API means forward and backward remain separate
         phases (the loss head lives outside the trunk, so a trunk cannot
         start backward before the caller's loss runs) — the memory
-        profile, not the phase interleaving, is what "1f1b" buys here;
-        see ARCHITECTURE.md.
+        profile, not the phase interleaving, is what this trunk variant
+        buys. For TRUE interleaved steady-state (per-microbatch head
+        loss on the last stage, backward starting the next tick, O(pp)
+        stash) use pipeline_train_1f1b below.
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
@@ -227,6 +229,185 @@ def _pipeline_trunk_1f1b(stage_fn: Callable, mesh, num_microbatches: int):
         trunk_local, mesh=mesh,
         in_specs=(P("pp"), P()),
         out_specs=P(),
+        axis_names={"pp"}, check_vma=False)
+
+
+def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable, mesh,
+                        num_microbatches: int):
+    """TRUE interleaved 1F1B (Megatron-LM PipeDream-flush): one scheduled
+    program computes loss AND grads, with the backward of microbatch f
+    starting the tick after its forward leaves the last stage — steady
+    state alternates one forward and one backward per stage.
+
+    This is what the trunk-level API (schedule="1f1b" above) cannot
+    express: there the loss head runs outside the trunk, so forward and
+    backward remain separate phases. Here head_loss_fn runs ON the last
+    stage at each forward tick and its cotangent enters the reverse ring
+    immediately. Peak stash is a min(pp, M)-deep ring of microbatch
+    inputs (vs M for the phase-split schedule).
+
+    Schedule (0-indexed): stage p runs fwd of microbatch f at tick
+    p + 2f and bwd of f at tick (2*pp - 1 - p) + 2f; fwd/bwd ticks have
+    opposite parity per stage, so each tick is exactly one unit of work,
+    selected with lax.cond (the unused branch is not computed).
+    Total ticks 2M + 2pp - 2; bubble (pp-1)/M, same as GPipe.
+
+    Args:
+      stage_fn(stage_params, x) -> y               (trunk slice)
+      head_loss_fn(head_params, y_mb, target_mb) -> scalar (per-mb loss)
+    Returns:
+      step(stacked_params, head_params, x, targets)
+        -> (loss, d_stacked, d_head, dx)
+      loss = mean over microbatches; d_stacked matches stacked_params
+      ([pp, ...] sharded over 'pp'); dx is the cotangent w.r.t. x (for
+      an embedding outside the pipeline).
+    """
+    pp = int(mesh.shape["pp"])
+    M = num_microbatches
+    W = min(pp, M)                       # stash ring depth
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+    rev_perm = [(i + 1, i) for i in range(pp - 1)]
+    ticks = 2 * M + 2 * pp - 2
+
+    def step_local(params_local, head_params, x, targets):
+        params_me = jax.tree.map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index("pp")
+        last = pp - 1
+        B = x.shape[0]
+        mb = B // M
+        xs = x.reshape((M, mb) + x.shape[1:])
+        ts = targets.reshape((M, mb) + targets.shape[1:])
+
+        def fwd_unit(operand):
+            params_me, inp, head_params, tgt, is_last = operand
+            y = stage_fn(params_me, inp)
+
+            def with_head(_):
+                (loss_mb, (dh, dy)) = jax.value_and_grad(
+                    head_loss_fn, argnums=(0, 1))(head_params, y, tgt)
+                return loss_mb, dh, dy
+
+            def no_head(_):
+                zh = jax.tree.map(jnp.zeros_like, head_params)
+                return jnp.zeros((), jnp.float32), zh, jnp.zeros_like(y)
+
+            loss_mb, dh, dy = jax.lax.cond(is_last, with_head, no_head,
+                                           None)
+            return y, loss_mb, dh, dy
+
+        def bwd_unit(operand):
+            params_me, inp, ct = operand
+            _, vjp_fn = jax.vjp(stage_fn, params_me, inp)
+            dp, dx = vjp_fn(ct.astype(inp.dtype))
+            return dp, dx
+
+        def tick(carry, t):
+            (act_in, ct_in, stash, dy_buf, dxs, dparams, dhead,
+             loss) = carry
+            # schedule decode for this (stage, tick)
+            tf = t - stage
+            do_fwd = jnp.logical_and(
+                jnp.logical_and(tf >= 0, tf % 2 == 0), tf // 2 < M)
+            f_fwd = jnp.clip(tf // 2, 0, M - 1)
+            tb = t - (2 * pp - 1 - stage)
+            do_bwd = jnp.logical_and(
+                jnp.logical_and(tb >= 0, tb % 2 == 0), tb // 2 < M)
+            f_bwd = jnp.clip(tb // 2, 0, M - 1)
+
+            # ---- forward unit -------------------------------------------
+            inp0 = jax.lax.dynamic_index_in_dim(xs, f_fwd, keepdims=False)
+            inp = jnp.where(stage == 0, inp0, act_in)
+            tgt = jax.lax.dynamic_index_in_dim(ts, f_fwd, keepdims=False)
+
+            def run_fwd(_):
+                return fwd_unit((params_me, inp, head_params, tgt,
+                                 stage == last))
+
+            def skip_fwd(_):
+                zh = jax.tree.map(jnp.zeros_like, head_params)
+                return (jnp.zeros_like(inp), jnp.zeros((), jnp.float32),
+                        zh, jnp.zeros_like(inp))
+
+            y, loss_mb, dh, dy = jax.lax.cond(do_fwd, run_fwd, skip_fwd,
+                                              None)
+            # stash this fwd's input for its backward (ring slot f mod W)
+            slot = f_fwd % W
+            cur = jax.lax.dynamic_index_in_dim(stash, slot, keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(do_fwd, inp, cur), slot, axis=0)
+            dy_buf = jnp.where(jnp.logical_and(do_fwd, stage == last),
+                               dy, dy_buf)
+            loss = loss + jnp.where(do_fwd, loss_mb, 0.0)
+            dhead = jax.tree.map(
+                lambda acc, d: acc + jnp.where(do_fwd, d, 0.0
+                                               ).astype(acc.dtype),
+                dhead, dh)
+
+            # ---- backward unit ------------------------------------------
+            ct = jnp.where(stage == last, dy_buf, ct_in)
+            slot_b = f_bwd % W
+            inp_b = jax.lax.dynamic_index_in_dim(stash, slot_b,
+                                                 keepdims=False)
+
+            def run_bwd(_):
+                return bwd_unit((params_me, inp_b, ct))
+
+            def skip_bwd(_):
+                return (jax.tree.map(jnp.zeros_like, params_me),
+                        jnp.zeros_like(inp_b))
+
+            dp, dx = jax.lax.cond(do_bwd, run_bwd, skip_bwd, None)
+            dparams = jax.tree.map(
+                lambda acc, d: acc + jnp.where(do_bwd, d, 0.0
+                                               ).astype(acc.dtype),
+                dparams, dp)
+            curx = jax.lax.dynamic_index_in_dim(dxs, f_bwd, keepdims=False)
+            bank = jnp.logical_and(do_bwd, stage == 0)
+            dxs = jax.lax.dynamic_update_index_in_dim(
+                dxs, jnp.where(bank, dx, curx), f_bwd, axis=0)
+
+            # ---- ring exchange (all stages participate every tick) ------
+            act_next = jax.lax.ppermute(jnp.where(do_fwd, y, 0.0),
+                                        "pp", fwd_perm)
+            ct_next = jax.lax.ppermute(jnp.where(do_bwd, dx, 0.0),
+                                       "pp", rev_perm)
+            return (act_next, ct_next, stash, dy_buf, dxs, dparams,
+                    dhead, loss), None
+
+        shp = (mb,) + x.shape[1:]
+        carry0 = (
+            jnp.zeros(shp, x.dtype),                        # act_in
+            jnp.zeros(shp, x.dtype),                        # ct_in
+            jnp.zeros((W,) + shp, x.dtype),                 # stash ring
+            jnp.zeros(shp, x.dtype),                        # dy_buf
+            jnp.zeros((M,) + shp, x.dtype),                 # dxs bank
+            jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32),
+                         params_me),                        # dparams
+            jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32),
+                         head_params),                      # dhead
+            jnp.zeros((), jnp.float32),                     # loss
+        )
+        (_, _, _, _, dxs, dparams, dhead, loss), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(ticks))
+
+        # owners: loss/dhead live on the last stage, dxs on stage 0 —
+        # zero the others and psum to replicate
+        loss = jax.lax.psum(jnp.where(stage == last, loss, 0.0), "pp") / M
+        dhead = jax.tree.map(
+            lambda d: jax.lax.psum(
+                jnp.where(stage == last, d, 0.0), "pp") / M, dhead)
+        dxs = jax.lax.psum(jnp.where(stage == 0, dxs,
+                                     jnp.zeros_like(dxs)), "pp")
+        dx = dxs.reshape(x.shape) / M
+        dparams_local = jax.tree.map(
+            lambda d, p: (d / M)[None].astype(jnp.float32),
+            dparams, params_me)
+        return loss, dparams_local, dhead, dx
+
+    return jax.shard_map(
+        step_local, mesh=mesh,
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=(P(), P("pp"), P(), P()),
         axis_names={"pp"}, check_vma=False)
 
 

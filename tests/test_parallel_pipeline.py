@@ -148,3 +148,57 @@ def test_1f1b_llama_training_step():
     np.testing.assert_allclose(losses["gpipe"], losses["1f1b"],
                                rtol=1e-4)
     assert losses["1f1b"][-1] < losses["1f1b"][0]
+
+
+def test_1f1b_interleaved_matches_autodiff():
+    """pipeline_train_1f1b (TRUE interleaved schedule: per-microbatch
+    head loss on the last stage, backward starts next tick) must match
+    plain autodiff of the sequential model: loss, trunk grads, head
+    grads, and input cotangent."""
+    from ray_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    L, D, B = 8, 16, 16
+    layers = {"w": jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.3,
+              "b": jax.random.normal(jax.random.PRNGKey(1), (L, D)) * 0.1}
+    head = {"w": jax.random.normal(jax.random.PRNGKey(2), (D, D)) * 0.3}
+    x = jax.random.normal(jax.random.PRNGKey(3), (B, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(4), (B, D))
+
+    def stage_fn(sp, h):
+        def body(h, lp):
+            return jnp.tanh(h @ lp["w"] + lp["b"]), None
+
+        h, _ = jax.lax.scan(body, h, sp)
+        return h
+
+    def head_loss(hp, y, t):
+        return jnp.mean((y @ hp["w"] - t) ** 2)
+
+    # sequential autodiff reference (mean over microbatches == full-batch
+    # mean for equal microbatch sizes)
+    def ref_loss(ly, hp, xx):
+        h = stage_fn(ly, xx)
+        return head_loss(hp, h, tgt)
+
+    ref = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2)))
+    loss_ref, (dl_ref, dh_ref, dx_ref) = ref(layers, head, x)
+
+    for pp, M, dp in [(2, 4, 4), (4, 8, 2), (4, 2, 2)]:
+        mesh = build_mesh(MeshSpec(dp=dp, pp=pp))
+        stacked = stack_stages(layers, pp)
+        step = pipeline_train_1f1b(stage_fn, head_loss, mesh, M)
+        loss, d_stacked, d_head, dx = jax.jit(step)(stacked, head, x, tgt)
+        from ray_tpu.parallel.pipeline import unstack_stages
+
+        d_layers = unstack_stages(d_stacked)
+        np.testing.assert_allclose(float(loss), float(loss_ref),
+                                   rtol=1e-5, atol=1e-6)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(d_layers[k]),
+                                       np.asarray(dl_ref[k]),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(d_head["w"]),
+                                   np.asarray(dh_ref["w"]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                                   rtol=1e-4, atol=1e-5)
