@@ -67,6 +67,14 @@ class LlamaConfig:
     # the forward scan) or "1f1b" (explicitly-scheduled backward with an
     # O(M)-activation stash; parallel/pipeline.py).
     pp_schedule: str = "gpipe"
+    # Layer loop form. True = lax.scan over stacked layer params (compact
+    # HLO, fast compiles). False = unrolled Python loop slicing one layer
+    # at a time — with FSDP this keeps each layer's param all-gather and
+    # grad reduce-scatter adjacent to its use, so buffer liveness frees
+    # the gathered bf16 copy per layer instead of holding the whole
+    # model's (XLA can hoist a scan-carried all-gather out of the loop,
+    # which costs a full unsharded param copy in HBM at 7B+ scale).
+    scan_layers: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -85,6 +93,13 @@ PRESETS: Dict[str, LlamaConfig] = {
                               max_seq_len=1024),
     "1b": LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
                       n_heads=16, n_kv_heads=8, d_ff=5632, max_seq_len=2048),
+    # OPT-2.7B-class (the reference's LLM scale proof model,
+    # release/alpa_tests/train_opt_2_7b_minimum.py), llama-style shapes
+    # with head_dim 128 for MXU/flash-kernel tiling. Largest preset that
+    # trains on ONE 16 GB v5e chip (adafactor; adam state would need 32 GB).
+    "2b7": LlamaConfig(vocab_size=32000, d_model=2560, n_layers=32,
+                       n_heads=20, n_kv_heads=20, d_ff=6912,
+                       max_seq_len=2048),
     "7b": LlamaConfig(),  # llama-2 7B shapes
     "70b": LlamaConfig(d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
                        d_ff=28672, vocab_size=32000, max_seq_len=4096),
@@ -295,13 +310,31 @@ def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None, collect_kv=False):
     return x, new_cache
 
 
-def forward(params, tokens, cfg: LlamaConfig, pos_offset=0):
+def _act_constraint(mesh, rules):
+    """Activation sharding constraint [batch, seq, embed] for the dense
+    forward. Without it GSPMD is free to re-replicate intermediates — at
+    7B the rematted attention backward materialized the FULL-batch
+    [B, H, S, S] f32 scores on every device (8 GB/chip at B=16 S=2048),
+    blowing v5e HBM; constraining the per-layer activation pins the
+    batch axis down and the whole backward stays batch-sharded."""
+    if mesh is None or rules is None:
+        return lambda x: x
+    from ray_tpu.parallel.sharding import named_sharding
+
+    sh = named_sharding(mesh, ("batch", "seq", None), rules)
+    return lambda x: jax.lax.with_sharding_constraint(x, sh)
+
+
+def forward(params, tokens, cfg: LlamaConfig, pos_offset=0, mesh=None,
+            rules=None):
     """Teacher-forced logits. tokens: [B, S] int32 -> [B, S, vocab] f32.
     pos_offset shifts RoPE positions (sequence-parallel shards pass their
-    global chunk offset)."""
+    global chunk offset). mesh+rules (optional) pin per-layer activation
+    shardings (see _act_constraint)."""
     dt = cfg.dtype
     B, S = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    con = _act_constraint(mesh, rules)
+    x = con(params["embed"].astype(dt)[tokens])
     if isinstance(pos_offset, int) and pos_offset == 0:
         cos, sin = _rope_tables(cfg.rope_theta, S, cfg.head_dim)
     else:
@@ -312,11 +345,16 @@ def forward(params, tokens, cfg: LlamaConfig, pos_offset=0):
 
     def body(x, lp):
         y, _ = _layer(x, lp, cfg, cos, sin)
-        return y, None
+        return con(y), None
 
     if cfg.remat:
         body = _checkpoint(body, cfg)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(body, x, params["layers"])
+    else:
+        for i in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[i], params["layers"])
+            x, _ = body(x, lp)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"].astype(dt)
     return logits.astype(jnp.float32) if cfg.f32_logits else logits
@@ -379,9 +417,11 @@ def forward_pp(params, tokens, cfg: LlamaConfig, mesh, num_microbatches=None):
     return logits.astype(jnp.float32) if cfg.f32_logits else logits
 
 
-def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
+def loss_fn(params, batch, cfg: LlamaConfig, mesh=None, rules=None):
     """Next-token cross-entropy. batch: {"tokens": [B, S+1]} or
-    {"inputs": [B,S], "targets": [B,S], optional "mask": [B,S]}."""
+    {"inputs": [B,S], "targets": [B,S], optional "mask": [B,S]}.
+    mesh+rules pin activation shardings in the dense path (required for
+    HBM-tight FSDP configs; see _act_constraint)."""
     if "tokens" in batch:
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
         mask = batch.get("mask")
@@ -396,7 +436,7 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None):
     elif mesh is not None and int(mesh.shape.get("pp", 1)) > 1:
         logits = forward_pp(params, inputs, cfg, mesh)
     else:
-        logits = forward(params, inputs, cfg)
+        logits = forward(params, inputs, cfg, mesh=mesh, rules=rules)
     # nll = logsumexp(logits) - logit[target]: same value/gradient as
     # log_softmax + gather but never materializes the [B, S, V] log_softmax
     # tensor (1 GB f32 at B=8 S=1024 V=32k — pure HBM traffic).

@@ -1,0 +1,59 @@
+"""125M-headline MFU sweep on the real chip (VERDICT r3 item 7).
+
+Tries the credible config levers one at a time against the bench.py
+methodology (marginal step time, best-of-N) and prints one JSON line per
+config, so the winner can be promoted into bench.py with data attached:
+
+  - remat off: at 125M the whole activation set fits HBM easily, so the
+    per-layer checkpoint's backward recompute (~+30% flops) is pure waste.
+  - fused qkv/gate-up matmuls: at d_model=768 the MXU is tile-bound;
+    wider N keeps the systolic array full (cfg.fused_matmuls).
+  - flash vs xla attention at S=1024.
+  - remat_policy="dots" middle ground.
+
+Run on the axon chip:  python release/mfu_sweep.py
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import sys
+
+
+def main():
+    sys.path.insert(0, ".")
+    from bench import run_train_bench
+
+    configs = [
+        {"label": "r3-baseline", "overrides": {}},
+        {"label": "noremat", "overrides": {"remat": False}},
+        {"label": "noremat+fused", "overrides": {"remat": False,
+                                                 "fused_matmuls": True}},
+        {"label": "fused", "overrides": {"fused_matmuls": True}},
+        {"label": "dots", "overrides": {"remat_policy": "dots"}},
+        {"label": "noremat+fused+xla",
+         "overrides": {"remat": False, "fused_matmuls": True,
+                       "attn_impl": "xla"}},
+        {"label": "noremat+fused+B16",
+         "overrides": {"remat": False, "fused_matmuls": True},
+         "batch": 16},
+    ]
+    best = None
+    for c in configs:
+        try:
+            r = run_train_bench("debug-125m", batch=c.get("batch"),
+                                config_overrides=c["overrides"])
+            out = {"label": c["label"], "mfu": r["extra"]["mfu"],
+                   "tokens_per_sec": r["value"],
+                   "batch": r["extra"]["batch"]}
+        except Exception as e:  # noqa: BLE001 — sweep must finish
+            out = {"label": c["label"], "error": str(e)[:200]}
+        print(json.dumps(out), flush=True)
+        if "mfu" in out and (best is None or out["mfu"] > best["mfu"]):
+            best = out
+    print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""North-star HBM feasibility in CI (VERDICT r3 item 3).
+
+Compiles the REAL 7B sharded train step against a device-less v5e:2x4
+TPU topology (jax.experimental.topologies): the actual XLA:TPU compiler
+enforces the 16 GB HBM budget — a config that does not fit fails with
+RESOURCE_EXHAUSTED — and reports per-device peak_memory_in_bytes.
+Reference analog: release/alpa_tests/train_opt_2_7b_minimum.py proves the
+reference's LLM scale path; BASELINE.md target 2 is Llama-2 7B on v5e-8.
+"""
+
+import numpy as np
+import pytest
+
+
+def _tpu_compiler_available():
+    try:
+        from jax.experimental import topologies
+
+        topologies.get_topology_desc(platform="tpu",
+                                     topology_name="v5e:2x4")
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tpu_compiler_available(),
+                    reason="libtpu AOT compiler not available")
+def test_llama7b_fsdp_fits_v5e8_hbm():
+    import os
+    import sys
+
+    rel = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       os.pardir, "release")
+    sys.path.insert(0, rel)
+    try:
+        from model_scale_benchmark import compile_case
+    finally:
+        sys.path.pop(0)
+    import jax.numpy as jnp
+
+    r = compile_case(preset="7b", chip="v5e", mesh_axes={"fsdp": 8},
+                     rules_name="fsdp", batch=8, seq=2048,
+                     mu_dtype=jnp.bfloat16)
+    assert r["fits"], r
+    assert r["peak_hbm_gb"] <= 16.0, r
+    # the projection should land in the plausible band for 7B on v5e
+    assert 1000 < r["projected_tokens_per_sec_per_chip"] < 20000, r
+    assert r["params"] > 6.5e9
