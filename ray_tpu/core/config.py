@@ -36,6 +36,11 @@ class Config:
     # above that 120 s bound or the reaper can free a buffer an active
     # (trickling) receive is still writing into.
     creating_orphan_age_s: float = 300.0
+    # --- HBM device object tier (SURVEY §7 step 2; core/device_store.py) ----
+    # put(jax.Array) keeps the buffer device-resident; D2H happens only on
+    # first remote need or on HBM pressure (spill chain HBM->shm->disk).
+    device_object_tier: bool = True
+    device_object_store_bytes: int = 2 * 1024**3
     # --- object spilling (ref: local_object_manager.h:41 + external_storage) -
     object_spill_enabled: bool = True
     object_spill_threshold: float = 0.8          # spill when usage crosses this

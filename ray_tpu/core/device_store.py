@@ -1,0 +1,104 @@
+"""HBM-resident object tier: device arrays stay on-device at put time.
+
+Reference: the plasma store (src/ray/object_manager/plasma/store.h:55) is
+the reference's primary tier — every object is host bytes in shm. On TPU
+the expensive copy is device<->host over PCIe, so this tier inverts the
+design (SURVEY §7 step 2): `put(jax.Array)` registers the live device
+buffer in a per-process table and defers the D2H transfer until a REMOTE
+consumer actually needs the bytes (host-staging through the shm store,
+from where the existing native transfer plane ships them) or until HBM
+pressure spills it. A same-process `get` returns the identical jax.Array
+object — zero copies, zero D2H.
+
+Spill chain: HBM (this table) -> host shm (store) -> disk (the nodelet's
+existing spill loop). Cross-process device sharing does not exist on TPU
+(each process owns its chip's context), so this tier is deliberately
+per-process; the shm tier remains the cross-process meeting point.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, List, Optional, Tuple
+
+
+def is_device_value(x: Any) -> bool:
+    """True for a jax.Array we can keep device-resident: a concrete,
+    fully-addressable array (a traced or multi-host-sharded value has no
+    locally-ownable buffers)."""
+    t = type(x)
+    if not (t.__module__.startswith("jax")
+            and t.__name__ in ("ArrayImpl", "Array")):
+        return False
+    try:
+        return bool(x.is_fully_addressable) and not x.is_deleted()
+    except Exception:
+        return False
+
+
+class DeviceStore:
+    """oid -> live jax.Array, LRU-ordered, byte-accounted.
+
+    Eviction is NOT decided here: the runtime asks for `victims(need)`
+    and host-stages them through the shm store before dropping, so a
+    device object is never lost — only demoted down the spill chain.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = int(capacity_bytes)
+        self._lock = threading.Lock()
+        self._objs: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
+        self.total = 0
+
+    def put(self, oid, arr) -> int:
+        nbytes = int(arr.nbytes)
+        with self._lock:
+            old = self._objs.pop(oid, None)
+            if old is not None:
+                self.total -= old[1]
+            self._objs[oid] = (arr, nbytes)
+            self.total += nbytes
+        return nbytes
+
+    def get(self, oid) -> Optional[Any]:
+        with self._lock:
+            ent = self._objs.get(oid)
+            if ent is None:
+                return None
+            self._objs.move_to_end(oid)     # LRU touch
+            return ent[0]
+
+    def contains(self, oid) -> bool:
+        with self._lock:
+            return oid in self._objs
+
+    def delete(self, oid) -> bool:
+        with self._lock:
+            ent = self._objs.pop(oid, None)
+            if ent is None:
+                return False
+            self.total -= ent[1]
+            return True
+
+    def over_capacity(self) -> int:
+        """Bytes above the watermark (0 if within budget)."""
+        with self._lock:
+            return max(self.total - self.capacity, 0)
+
+    def victims(self, need_bytes: int) -> List[Any]:
+        """Oldest-first oids whose combined size covers `need_bytes`.
+        Does not remove them — the runtime stages each to shm first."""
+        out, covered = [], 0
+        with self._lock:
+            for oid, (_, nbytes) in self._objs.items():
+                if covered >= need_bytes:
+                    break
+                out.append(oid)
+                covered += nbytes
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"objects": len(self._objs), "bytes": self.total,
+                    "capacity": self.capacity}

@@ -233,6 +233,12 @@ class Runtime:
         self.server = RpcServer(self)
         self.memory_store = MemoryStore()
         self.store = SharedMemoryStore(store_name)
+        from ray_tpu.core.device_store import DeviceStore
+
+        # HBM tier (SURVEY §7 step 2): device arrays put() here stay
+        # on-device; D2H staging is lazy (first remote need / pressure)
+        self.device_store = DeviceStore(cfg.device_object_store_bytes)
+        self._stage_lock = threading.Lock()
         self.refs = ReferenceCounter(self._self_addr, self._free_object,
                                      self._notify_owner,
                                      on_borrow_zero=self._free_borrow_caches)
@@ -429,7 +435,24 @@ class Runtime:
             return e
 
     def put(self, value: Any, _pin: bool = True) -> ObjectRef:
-        """ref: CoreWorker::Put core_worker.cc:1119."""
+        """ref: CoreWorker::Put core_worker.cc:1119 — plus the HBM tier:
+        a device array skips serialization entirely (no D2H, no shm
+        write); same-process get returns the identical jax.Array, and
+        _stage_device_object demotes it to shm only when a remote
+        consumer or HBM pressure demands host bytes."""
+        from ray_tpu.core.device_store import is_device_value
+
+        if (self.cfg.device_object_tier and is_device_value(value)
+                and value.nbytes > self.cfg.max_direct_call_object_size):
+            oid = self._next_put_id()
+            e = self._entry(oid)
+            self.refs.register_owned(oid)
+            e.size = self.device_store.put(oid, value)
+            self.memory_store.put(oid, value)
+            e.state = "ready"
+            self._complete_entry(e)
+            self._enforce_device_capacity()
+            return ObjectRef(oid, self.address)
         oid = self._next_put_id()
         meta, bufs = serialization.serialize(value)
         size = serialization.serialized_size(meta, bufs)
@@ -496,6 +519,95 @@ class Runtime:
                 return None  # nothing left to spill; store genuinely full
         return None
 
+    # --- HBM device tier (core/device_store.py; SURVEY §7 step 2) -----------
+
+    def _stage_device_object(self, oid: ObjectID, drop: bool = False) -> bool:
+        """Demote a device-tier object to the host shm tier: D2H +
+        serialize + seal + pin, then advertise this node as a location —
+        from here the existing transfer/spill machinery applies. With
+        drop=True the device copy is released (pressure spill); without,
+        the device copy stays the same-process fast path. Returns False
+        only if the shm store cannot hold the bytes."""
+        with self._stage_lock:
+            arr = self.device_store.get(oid)
+            if arr is None:
+                return self.store.contains(oid)
+            e = self._entry(oid)
+            if getattr(arr, "is_deleted", lambda: False)():
+                # the user donated the live buffer without take(): the
+                # bytes are unrecoverable. Mark lost (an explicit error
+                # on get) instead of letting the deleted-array raise
+                # escape from an unrelated put()'s capacity sweep.
+                self.device_store.delete(oid)
+                self.memory_store.delete(oid)
+                e.state = "lost"
+                logger.warning(
+                    "device object %s was deleted under the tier "
+                    "(donated without take()?) — marked lost",
+                    oid.hex()[:12])
+                return False
+            if not self.store.contains(oid):
+                try:
+                    meta, bufs = serialization.serialize(arr)  # the D2H copy
+                except Exception:   # deletion raced the check above
+                    self.device_store.delete(oid)
+                    self.memory_store.delete(oid)
+                    e.state = "lost"
+                    return False
+                size = serialization.serialized_size(meta, bufs)
+                view = self._create_view_with_spill(oid, size)
+                if view is None and not self.store.contains(oid):
+                    return False
+                if view is not None:
+                    serialization.write_to(view, meta, bufs)
+                    del view
+                    self.store.seal(oid)
+                self._pin_primary(oid)
+                with self._dir_lock:
+                    e.locations.add(self.nodelet_addr)
+                    e.primaries.add(self.nodelet_addr)
+                e.size = size
+            if drop:
+                self.device_store.delete(oid)
+                self.memory_store.delete(oid)
+            return True
+
+    def _enforce_device_capacity(self):
+        """HBM watermark: stage LRU device objects down to shm until the
+        tier fits its budget (the shm tier then spills to disk under its
+        own watermarks — the full HBM->host->disk chain)."""
+        over = self.device_store.over_capacity()
+        if over <= 0:
+            return
+        for victim in self.device_store.victims(over):
+            if not self._stage_device_object(victim, drop=True):
+                logger.warning(
+                    "device tier over budget but shm cannot absorb %s",
+                    victim.hex()[:12])
+
+    def take(self, ref: ObjectRef):
+        """Donation-aware get (train/serve hot path): returns the device
+        array AND withdraws it from the object tiers, transferring buffer
+        ownership to the caller — safe to donate into a jit without
+        invalidating a stored copy behind other readers' backs. Only the
+        owner may take, and the ref must still be device-resident.
+        Subsequent gets raise ObjectLostError (put objects have no
+        lineage)."""
+        oid = ref.id
+        if not self.refs.is_owned(oid):
+            raise ValueError("take() requires the owning process "
+                             "(borrowers hold host copies)")
+        arr = self.device_store.get(oid)
+        if arr is None:
+            raise ValueError(
+                f"object {oid.hex()[:12]} is not device-resident "
+                "(already staged, spilled, or not a device put)")
+        self.device_store.delete(oid)
+        self.memory_store.delete(oid)
+        e = self._entry(oid)
+        e.state = "lost"
+        return arr
+
     def _free_borrow_caches(self, oid: ObjectID):
         """Last local borrow of a remote-owned object died: drop OUR
         caches only (the owner's copy is none of our business)."""
@@ -506,6 +618,7 @@ class Runtime:
         """All refs gone: drop every copy (ref: ReferenceCounter on-zero →
         delete from plasma + local memory store; lineage released)."""
         self.memory_store.delete(oid)
+        self.device_store.delete(oid)
         # NOT store.release here: live zero-copy values hold their own
         # pin via _ReadPin and release when the last one dies
         self._pinned.pop(oid, None)
@@ -1680,7 +1793,8 @@ class Runtime:
         st = self._actor_state.get(actor_id)
         if st is not None and st.get("state") == "DEAD":
             raise ActorDiedError(f"actor {actor_id.hex()[:12]} is dead: "
-                                 f"{st.get('death_cause')}")
+                                 f"{st.get('death_cause')}",
+                                 actor_id=actor_id.hex())
         deadline = None if timeout is None else time.time() + timeout
         view = None
         while not self._shutdown:
@@ -1701,7 +1815,8 @@ class Runtime:
             if deadline is not None and time.time() >= deadline:
                 break
         cause = (view or {}).get("death_cause", "not alive in time")
-        raise ActorDiedError(f"actor {actor_id.hex()[:12]}: {cause}")
+        raise ActorDiedError(f"actor {actor_id.hex()[:12]}: {cause}",
+                             actor_id=actor_id.hex())
 
     def submit_actor_call(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict, *, num_returns: int = 1,
@@ -1822,7 +1937,8 @@ class Runtime:
         else:
             cause = (view or {}).get("death_cause", str(err))
             self._fail_task_returns(spec, ActorDiedError(
-                f"actor {actor_id.hex()[:12]} died: {cause}"))
+                f"actor {actor_id.hex()[:12]} died: {cause}",
+                actor_id=actor_id.hex()))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.gcs_call("kill_actor", actor_id=actor_id, no_restart=no_restart)
@@ -1842,6 +1958,17 @@ class Runtime:
             return {"status": "lost"}
         if e.inline is not None:
             return {"status": "ready", "inline": e.inline}
+        if self.device_store.contains(oid):
+            # first remote need of a device-tier object: host-stage it
+            # (D2H + shm write, off the loop) and answer with locations —
+            # the data plane, not this control RPC, carries the bytes
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None, self._stage_device_object, oid)
+            if ok:
+                with self._dir_lock:
+                    locs = [list(a) for a in e.locations]
+                return {"status": "ready", "inline": None,
+                        "locations": locs}
         v = self.memory_store.get_if_exists(oid)
         if v is not _MISSING and not isinstance(v, serialization.SerializedException):
             return {"status": "ready", "inline": serialization.pack(v)}

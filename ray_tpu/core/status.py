@@ -27,7 +27,19 @@ class WorkerCrashedError(RayTpuError):
 
 
 class ActorDiedError(RayTpuError):
-    """Actor is dead and (re)start budget is exhausted (ref: RayActorError)."""
+    """Actor is dead and (re)start budget is exhausted (ref: RayActorError).
+
+    Carries the dead actor's id (hex) so routing layers can evict the
+    exact replica locally instead of waiting for a control-plane probe
+    (ref: RayActorError.actor_id)."""
+
+    def __init__(self, msg: str = "", actor_id: str = None):
+        super().__init__(msg)
+        self.actor_id = actor_id
+
+    def __reduce__(self):   # keep actor_id across pickling
+        return (type(self), (self.args[0] if self.args else "",
+                             self.actor_id))
 
 
 class ActorUnavailableError(RayTpuError):

@@ -1,0 +1,126 @@
+"""HBM device object tier (core/device_store.py; SURVEY §7 step 2).
+
+The TPU-first inversion of plasma (ref:
+src/ray/object_manager/plasma/store.h:55 — host shm as the only tier):
+put(jax.Array) keeps the buffer on-device; the D2H copy happens only on
+first REMOTE need (host-staging through the shm store) or on HBM
+pressure (spill chain HBM -> shm -> disk). On CPU-jax these tests
+exercise identical code paths — jax.Array buffers are "device" buffers
+of the CPU backend.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+
+
+def _buf_ptr(arr):
+    return arr.addressable_data(0).unsafe_buffer_pointer()
+
+
+def test_same_process_put_get_zero_copy(ray_start_regular):
+    """Owner-side get returns the IDENTICAL jax.Array — no D2H, no copy
+    (assert via the device buffer pointer), and no shm write happened."""
+    rt = ray_tpu.core.runtime.get_runtime()
+    arr = jnp.arange(1 << 16, dtype=jnp.float32)  # 256 KiB > inline max
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert out is arr
+    assert _buf_ptr(out) == _buf_ptr(arr)
+    assert rt.device_store.contains(ref.id)
+    assert not rt.store.contains(ref.id)      # staging was never needed
+    assert rt.device_store.stats()["bytes"] == arr.nbytes
+
+
+def test_remote_consumer_host_stages(ray_start_regular):
+    """A remote worker's get triggers lazy staging: the consumer sees
+    host numpy with the right contents; the owner's shm store now holds
+    the staged copy (from where the transfer plane serves it)."""
+    rt = ray_tpu.core.runtime.get_runtime()
+    arr = jnp.arange(1 << 15, dtype=jnp.float32) * 2.0
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def consume(x):
+        assert isinstance(x, np.ndarray)
+        return float(x.sum()), x.shape
+
+    total, shape = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert total == float(np.asarray(arr).sum())
+    assert shape == arr.shape
+    # staged to shm, but the device copy is still the local fast path
+    assert rt.store.contains(ref.id)
+    assert rt.device_store.contains(ref.id)
+    assert ray_tpu.get(ref) is arr
+
+
+def test_capacity_watermark_spills_lru_to_host(ray_start_regular):
+    """Over-budget device tier demotes oldest-first to shm; the demoted
+    object's get returns the host (numpy) copy, the survivor stays
+    device-resident."""
+    rt = ray_tpu.core.runtime.get_runtime()
+    old_cap = rt.device_store.capacity
+    arr_a = jnp.ones((256, 1024), jnp.float32)        # 1 MiB
+    arr_b = jnp.full((256, 1024), 3.0, jnp.float32)   # 1 MiB
+    try:
+        rt.device_store.capacity = int(1.5 * arr_a.nbytes)
+        ref_a = ray_tpu.put(arr_a)
+        assert rt.device_store.contains(ref_a.id)
+        ref_b = ray_tpu.put(arr_b)                    # pushes over budget
+        assert not rt.device_store.contains(ref_a.id)  # LRU victim staged
+        assert rt.store.contains(ref_a.id)
+        assert rt.device_store.contains(ref_b.id)
+        a = ray_tpu.get(ref_a)
+        assert isinstance(a, np.ndarray) and float(a[0, 0]) == 1.0
+        assert ray_tpu.get(ref_b) is arr_b
+    finally:
+        rt.device_store.capacity = old_cap
+
+
+def test_free_releases_device_bytes(ray_start_regular):
+    rt = ray_tpu.core.runtime.get_runtime()
+    before = rt.device_store.stats()["bytes"]
+    ref = ray_tpu.put(jnp.zeros(1 << 15, jnp.float32))
+    assert rt.device_store.stats()["bytes"] > before
+    oid = ref.id
+    del ref
+    import gc
+
+    gc.collect()
+    deadline = __import__("time").time() + 10
+    while __import__("time").time() < deadline:
+        if not rt.device_store.contains(oid):
+            break
+        __import__("time").sleep(0.1)
+    assert not rt.device_store.contains(oid)
+    assert rt.device_store.stats()["bytes"] == before
+
+
+def test_take_transfers_ownership_for_donation(ray_start_regular):
+    """Donation-aware get (train hot path): take() hands the caller the
+    live buffer and withdraws it from the tiers, so donating it into a
+    jit cannot corrupt a stored copy behind other readers."""
+    rt = ray_tpu.core.runtime.get_runtime()
+    arr = jnp.arange(1 << 15, dtype=jnp.float32)
+    ref = ray_tpu.put(arr)
+    got = rt.take(ref)
+    assert got is arr
+    assert not rt.device_store.contains(ref.id)
+    with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+        ray_tpu.get(ref, timeout=5)
+    # a donating consumer can now safely hand the buffer to XLA
+    out = jax.jit(lambda x: x * 2, donate_argnums=0)(got)
+    assert float(out[1]) == 2.0
+
+
+def test_non_array_values_unaffected(ray_start_regular):
+    """Plain host values keep the classic path (inline or shm)."""
+    rt = ray_tpu.core.runtime.get_runtime()
+    ref = ray_tpu.put({"x": np.ones(1 << 15, np.float32)})
+    assert not rt.device_store.contains(ref.id)
+    out = ray_tpu.get(ref)
+    assert float(out["x"].sum()) == float(1 << 15)
