@@ -217,7 +217,14 @@ class DashboardHead:
         limit = int(request.query.get("limit", 2000))
         events = await self._gcs("list_task_events", limit=limit)
         spans = [ev for ev in events if ev.get("kind") == "span"]
-        tasks: Dict[str, dict] = {}
+        # Fold into a PERSISTENT per-task cache: the GCS store keeps only
+        # the newest `limit` events, so a long-running task's RUNNING
+        # event can age out while its FINISHED remains — folding only the
+        # current window would then yield FINISHED rows with null
+        # start_ts/duration. Re-folding the same event is idempotent, so
+        # the cache just accumulates the newest window each tick.
+        tasks: Dict[str, dict] = getattr(self, "_task_rows", None) or {}
+        self._task_rows = tasks
         # events from different processes flush independently and
         # interleave out of order in the GCS — fold by timestamp, or a
         # late-arriving PENDING overwrites a FINISHED forever
@@ -227,7 +234,10 @@ class DashboardHead:
                 "task_id": ev["task_id"], "name": ev.get("name"),
                 "actor_id": ev.get("actor_id"), "worker": None,
                 "state": None, "start_ts": None, "end_ts": None,
-                "duration_s": None})
+                "duration_s": None, "_last_ts": 0.0})
+            if ev["ts"] < t["_last_ts"]:
+                continue   # older than what's already folded for this task
+            t["_last_ts"] = ev["ts"]
             t["state"] = ev.get("state")
             if ev.get("worker"):
                 t["worker"] = ev["worker"]
@@ -237,8 +247,23 @@ class DashboardHead:
                 t["end_ts"] = ev["ts"]
                 if t["start_ts"] is not None:
                     t["duration_s"] = ev["ts"] - t["start_ts"]
+        # bound the cache: evict oldest FINISHED/FAILED first, then (if a
+        # churning cluster left terminal-less rows — e.g. a SIGKILLed
+        # worker never flushed its FINISHED span) oldest rows of ANY
+        # state, so the cache cannot grow without bound
+        cap = 10000
+        if len(tasks) > cap:
+            by_age = sorted(tasks.values(), key=lambda t: t["_last_ts"])
+            terminal = [t for t in by_age
+                        if t["state"] in ("FINISHED", "FAILED")]
+            rest = [t for t in by_age
+                    if t["state"] not in ("FINISHED", "FAILED")]
+            for t in (terminal + rest)[:len(tasks) - cap]:
+                tasks.pop(t["task_id"], None)
         out = sorted(tasks.values(),
                      key=lambda t: t.get("start_ts") or 0, reverse=True)
+        out = [{k: v for k, v in t.items() if k != "_last_ts"}
+               for t in out[:limit]]   # honor ?limit= on the response too
         return self._json({"tasks": out, "spans": spans})
 
     async def _h_jobs(self, request):

@@ -133,9 +133,14 @@ def _get_or_start_controller():
         from ray_tpu.serve.controller import ServeController
 
         try:
+            # max_restarts=-1: the controller is a checkpointed state
+            # machine (GCS KV) — on death it restarts, restores the
+            # deployment table, and re-adopts live named replicas
+            # (ref: serve/controller.py:74). max_concurrency sized for
+            # one pending long-poll per router/proxy subscriber.
             return ServeController.options(
                 name=CONTROLLER_NAME, namespace=_NAMESPACE,
-                max_concurrency=16).remote()
+                max_restarts=-1, max_concurrency=64).remote()
         except ValueError:
             return ray_tpu.get_actor(CONTROLLER_NAME, namespace=_NAMESPACE)
 

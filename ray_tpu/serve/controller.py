@@ -2,17 +2,30 @@
 
 Reference: python/ray/serve/controller.py:74 (checkpointed controller state
 machine), _private/deployment_state.py:1097 (replica FSM, rolling updates,
-_scale_deployment_replicas:1537), _private/replica.py, autoscaling on
-replica queue metrics (_private/autoscaling_policy.py).
+_scale_deployment_replicas:1537), _private/replica.py, long-poll
+control-plane push (_private/long_poll.py:69,187), autoscaling on replica
+queue metrics with look-back + up/down delays
+(_private/autoscaling_policy.py).
+
+Fault tolerance: the controller persists its deployment table (blobs,
+configs, routes, versions, replica ACTOR NAMES) to GCS KV on every
+mutation and runs with max_restarts=-1. Replicas are named actors, so a
+restarted controller re-adopts the live ones by name — no redeploys, no
+dropped replicas (the reference recovers the same way from its KV
+checkpoints, controller.py:74-79).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+
+STATE_KEY = b"controller_state"
+_KV_NS = "serve"
 
 
 @ray_tpu.remote
@@ -112,18 +125,122 @@ class Replica:
         return True
 
 
+def _kv_put(key: bytes, value: bytes):
+    from ray_tpu.core import runtime as _rt
+
+    _rt.get_runtime().kv_put(_KV_NS, key, value)
+
+
+def _kv_get(key: bytes) -> Optional[bytes]:
+    from ray_tpu.core import runtime as _rt
+
+    return _rt.get_runtime().kv_get(_KV_NS, key)
+
+
 @ray_tpu.remote
 class ServeController:
     """Deployment table + reconcile/autoscale thread
-    (ref: controller.py run_control_loop)."""
+    (ref: controller.py run_control_loop).
+
+    In-memory `deployments[name]` holds live actor handles in "replicas"
+    and their names in "replica_names" (parallel lists); the persisted
+    checkpoint stores everything EXCEPT the handles."""
 
     def __init__(self):
         self.deployments: Dict[str, dict] = {}
         self.routes: Dict[str, str] = {}   # route_prefix -> ingress deployment
         self._lock = threading.Lock()
         self._stop = False
+        # long-poll channels (ref: long_poll.py LongPollHost): generation
+        # per key; waiters block on the condition until the key's gen
+        # advances past theirs.
+        self._gen: Dict[str, int] = {}
+        self._poll_cond = threading.Condition()
+        # autoscaling look-back samples: name -> list[(ts, total_queue)]
+        self._qhist: Dict[str, List[tuple]] = {}
+        # pending scale decision: name -> (direction, first_seen_ts, want)
+        self._pending_scale: Dict[str, tuple] = {}
+        self._restore()
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
         self._thread.start()
+
+    # ---- persistence (ref: controller.py:74 checkpointed state) ------------
+
+    def _save(self):
+        import cloudpickle
+
+        with self._lock:
+            snap = {
+                "routes": dict(self.routes),
+                "deployments": {
+                    name: {k: d[k] for k in
+                           ("blob", "args", "kwargs", "config", "version",
+                            "replica_names")}
+                    for name, d in self.deployments.items()
+                },
+            }
+        try:
+            _kv_put(STATE_KEY, cloudpickle.dumps(snap))
+        except Exception:
+            pass  # KV down: state is still live in-memory; next save retries
+
+    def _restore(self):
+        import cloudpickle
+
+        try:
+            raw = _kv_get(STATE_KEY)
+        except Exception:
+            raw = None
+        if not raw:
+            return
+        snap = cloudpickle.loads(raw)
+        self.routes = dict(snap.get("routes", {}))
+        for name, d in snap.get("deployments", {}).items():
+            replicas, names = [], []
+            for rn in d.get("replica_names", []):
+                # re-adopt replicas that survived the controller crash —
+                # zero redeploys for live actors
+                try:
+                    h = ray_tpu.get_actor(rn, namespace=_KV_NS)
+                    ray_tpu.get(h.queue_len.remote(), timeout=5)
+                    replicas.append(h)
+                    names.append(rn)
+                except Exception:
+                    pass
+            self.deployments[name] = {**d, "replicas": replicas,
+                                      "replica_names": names}
+        # top up any deployment that lost replicas while we were down
+        for name in list(self.deployments):
+            self._reconcile(name)
+
+    # ---- long-poll push (ref: long_poll.py:187) ----------------------------
+
+    def _bump(self, key: str):
+        with self._poll_cond:
+            self._gen[key] = self._gen.get(key, 0) + 1
+            self._poll_cond.notify_all()
+
+    def _snapshot(self, key: str):
+        if key == "routes":
+            return dict(self.routes)
+        if key.startswith("replicas:"):
+            return self.get_replicas(key.split(":", 1)[1])
+        return None
+
+    def long_poll(self, key: str, last_gen: int, timeout: float = 10.0):
+        """Block until channel `key`'s generation advances past last_gen
+        (or timeout); returns {"gen": g, "value": snapshot}. Routers and
+        proxies keep one of these pending instead of polling on a timer —
+        a config/replica change propagates in one RPC round trip."""
+        deadline = time.time() + timeout
+        with self._poll_cond:
+            while self._gen.get(key, 0) <= last_gen:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._poll_cond.wait(remaining)
+            g = self._gen.get(key, 0)
+        return {"gen": g, "value": self._snapshot(key)}
 
     # ---- API ----------------------------------------------------------------
 
@@ -135,9 +252,11 @@ class ServeController:
                 "blob": import_blob, "args": init_args,
                 "kwargs": init_kwargs or {}, "config": dict(config),
                 "replicas": old["replicas"] if old else [],
+                "replica_names": old["replica_names"] if old else [],
                 "version": (old["version"] + 1) if old else 0,
             }
         self._reconcile(name, rolling=old is not None)
+        self._save()
         return True
 
     def delete_deployment(self, name: str) -> bool:
@@ -150,6 +269,9 @@ class ServeController:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+        self._save()
+        self._bump(f"replicas:{name}")
+        self._bump("routes")
         return True
 
     def get_replicas(self, name: str) -> List[Any]:
@@ -159,6 +281,8 @@ class ServeController:
     def set_route(self, route_prefix: str, deployment: str) -> bool:
         with self._lock:
             self.routes[route_prefix] = deployment
+        self._save()
+        self._bump("routes")
         return True
 
     def get_routes(self) -> Dict[str, str]:
@@ -176,13 +300,16 @@ class ServeController:
 
     # ---- reconcile ----------------------------------------------------------
 
-    def _make_replica(self, d: dict):
+    def _make_replica(self, name: str, d: dict):
         cfg = d["config"]
         opts = {"max_concurrency": cfg.get("max_concurrent_queries", 100)}
         if cfg.get("ray_actor_options"):
             opts.update(cfg["ray_actor_options"])
-        return Replica.options(**opts).remote(
+        # named so a restarted controller can re-adopt it (see _restore)
+        rname = f"_serve_rep_{name}_{uuid.uuid4().hex[:8]}"
+        h = Replica.options(name=rname, namespace=_KV_NS, **opts).remote(
             d["blob"], d["args"], d["kwargs"], cfg.get("user_config"))
+        return h, rname
 
     def _reconcile(self, name: str, rolling: bool = False):
         with self._lock:
@@ -190,24 +317,76 @@ class ServeController:
             if d is None:
                 return
             target = int(d["config"].get("num_replicas", 1))
-            replicas = d["replicas"]
+            health_timeout = float(
+                d["config"].get("health_check_timeout_s", 30.0))
+            replicas = list(d["replicas"])
+            names = list(d["replica_names"])
         if rolling:
-            # rolling update: replace one at a time (ref:
-            # deployment_state.py rolling update path)
-            new = []
-            for r in replicas:
-                nr = self._make_replica(d)
-                ray_tpu.get(nr.queue_len.remote())     # wait ready
+            # rolling update: replace one at a time; a new replica that
+            # fails its readiness deadline ABORTS the update, keeping the
+            # old replicas serving (ref: deployment_state.py rolling
+            # update + health deadline)
+            new, new_names = [], []
+            aborted = False
+            for i, r in enumerate(replicas):
+                nr, nn = self._make_replica(name, d)
+                try:
+                    ray_tpu.get(nr.queue_len.remote(),
+                                timeout=health_timeout)   # wait ready
+                except Exception:
+                    try:
+                        ray_tpu.kill(nr)
+                    except Exception:
+                        pass
+                    new.extend(replicas[i:])
+                    new_names.extend(names[i:])
+                    aborted = True
+                    break
                 try:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
                 new.append(nr)
-            replicas = new
-        while len(replicas) < target:
-            replicas.append(self._make_replica(d))
+                new_names.append(nn)
+            replicas, names = new, new_names
+            if aborted:
+                with self._lock:
+                    if name in self.deployments:
+                        self.deployments[name]["replicas"] = replicas
+                        self.deployments[name]["replica_names"] = names
+                        self.deployments[name]["last_error"] = (
+                            "rolling update aborted: new replica failed "
+                            f"readiness within {health_timeout}s")
+                self._save()
+                self._bump(f"replicas:{name}")
+                return
+        # Scale-up: start all missing replicas concurrently, then
+        # readiness-gate EVERY entry to the serving set, not just rolling
+        # swaps — after an aborted update the table may hold a blob whose
+        # __init__ fails, and scale-up must not hand routers a broken
+        # replica. Failures are killed and surfaced via last_error; the
+        # control loop retries next tick (ref: deployment_state keeps
+        # retrying and surfaces UNHEALTHY, it does not roll back).
+        started = [self._make_replica(name, d)
+                   for _ in range(max(target - len(replicas), 0))]
+        for h, rn in started:
+            try:
+                ray_tpu.get(h.queue_len.remote(), timeout=health_timeout)
+            except Exception as err:   # noqa: BLE001 — any startup failure
+                try:
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+                with self._lock:
+                    if name in self.deployments:
+                        self.deployments[name]["last_error"] = (
+                            f"replica failed readiness: {err}")
+                continue
+            replicas.append(h)
+            names.append(rn)
         while len(replicas) > target:
             r = replicas.pop()
+            names.pop()
             try:
                 ray_tpu.kill(r)
             except Exception:
@@ -215,43 +394,77 @@ class ServeController:
         with self._lock:
             if name in self.deployments:
                 self.deployments[name]["replicas"] = replicas
+                self.deployments[name]["replica_names"] = names
+        self._save()
+        self._bump(f"replicas:{name}")
+
+    # ---- autoscaling (ref: autoscaling_policy.py) --------------------------
+
+    def _autoscale_decision(self, name: str, d: dict, total: int):
+        """Look-back averaged queue depth + upscale/downscale delays.
+        Returns the target replica count to apply now, or None."""
+        auto = d["config"].get("autoscaling_config")
+        if not auto:
+            return None
+        now = time.time()
+        look_back = float(auto.get("look_back_period_s", 30.0))
+        hist = self._qhist.setdefault(name, [])
+        hist.append((now, total))
+        while hist and hist[0][0] < now - look_back:
+            hist.pop(0)
+        avg = sum(q for _, q in hist) / max(len(hist), 1)
+        per = auto.get("target_num_ongoing_requests_per_replica", 2)
+        cur = len(d["replicas"])
+        want = max(auto.get("min_replicas", 1),
+                   min(auto.get("max_replicas", 4),
+                       int((avg + per - 1) // per) or 1))
+        if want == cur:
+            self._pending_scale.pop(name, None)
+            return None
+        direction = "up" if want > cur else "down"
+        delay = float(auto.get("upscale_delay_s", 30.0) if direction == "up"
+                      else auto.get("downscale_delay_s", 600.0))
+        pend = self._pending_scale.get(name)
+        if pend is None or pend[0] != direction:
+            self._pending_scale[name] = (direction, now, want)
+            pend = self._pending_scale[name]
+        if now - pend[1] >= delay:
+            self._pending_scale.pop(name, None)
+            return want
+        return None
 
     def _control_loop(self):
-        """Autoscaling on queue depth (ref: autoscaling_policy.py — target
-        ongoing requests per replica) + dead-replica replacement."""
+        """Dead-replica replacement + windowed autoscaling."""
         while not self._stop:
             time.sleep(1.0)
             for name in list(self.deployments):
                 d = self.deployments.get(name)
                 if d is None:
                     continue
-                auto = d["config"].get("autoscaling_config")
                 # replace dead replicas
-                alive = []
-                for r in d["replicas"]:
+                alive, alive_names = [], []
+                for r, rn in zip(d["replicas"], d["replica_names"]):
                     try:
                         ray_tpu.get(r.queue_len.remote(), timeout=5)
                         alive.append(r)
+                        alive_names.append(rn)
                     except Exception:
                         pass
                 if len(alive) != len(d["replicas"]):
                     with self._lock:
                         d["replicas"] = alive
+                        d["replica_names"] = alive_names
                     self._reconcile(name)
                     continue
-                if not auto:
+                if not d["config"].get("autoscaling_config"):
                     continue
                 try:
                     qs = ray_tpu.get([r.queue_len.remote()
                                       for r in d["replicas"]], timeout=5)
                 except Exception:
                     continue
-                total = sum(qs)
-                per = auto.get("target_num_ongoing_requests_per_replica", 2)
-                want = max(auto.get("min_replicas", 1),
-                           min(auto.get("max_replicas", 4),
-                               (total + per - 1) // per or 1))
-                if want != len(d["replicas"]):
+                want = self._autoscale_decision(name, d, sum(qs))
+                if want is not None and want != len(d["replicas"]):
                     with self._lock:
                         d["config"]["num_replicas"] = want
                     self._reconcile(name)

@@ -1,10 +1,13 @@
 """DeploymentHandle + Router: client-side replica scheduling.
 
 Reference: python/ray/serve/handle.py:86 (RayServeHandle) and
-_private/router.py:262 (PowerOfTwoChoicesReplicaScheduler). The router keeps
-a local in-flight counter per replica and picks the less-loaded of two
-random candidates — queue-length routing without extra RPCs (the reference
-gets queue lengths pushed via long-poll; local counters approximate it).
+_private/router.py:262 (PowerOfTwoChoicesReplicaScheduler). The router
+keeps a local in-flight counter per replica and picks the less-loaded of
+two random candidates. Replica-set changes are PUSHED from the controller
+over a pending long-poll call (ref: _private/long_poll.py:69 LongPollClient
+— one blocking RPC held open per channel instead of a 5 s timer), so a
+deploy/scale/replica-death propagates to every router in one RPC round
+trip rather than a poll interval.
 """
 
 from __future__ import annotations
@@ -25,10 +28,44 @@ class Router:
         self._inflight: Dict[int, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._gen = 0
+        self._poller_started = False
+
+    def _ensure_poller(self):
+        if self._poller_started:
+            return
+        self._poller_started = True
+        threading.Thread(target=self._poll_loop, daemon=True).start()
+
+    def _poll_loop(self):
+        """Long-poll push loop: one pending controller call per router;
+        returns immediately when the replica set changes (see
+        ServeController.long_poll)."""
+        key = f"replicas:{self.deployment_name}"
+        while True:
+            try:
+                controller = ray_tpu.get_actor(self.controller_name,
+                                               namespace="serve")
+                res = ray_tpu.get(
+                    controller.long_poll.remote(key, self._gen, 10.0),
+                    timeout=30)
+                changed = res["gen"] != self._gen
+                self._gen = res["gen"]
+                if changed and res["value"] is not None:
+                    with self._lock:
+                        self._replicas = res["value"]
+                        self._inflight = {
+                            i: self._inflight.get(i, 0)
+                            for i in range(len(res["value"]))}
+                        self._last_refresh = time.time()
+            except Exception:
+                # controller down/restarting: back off, then re-resolve
+                # the (possibly restarted) named actor and re-subscribe
+                time.sleep(1.0)
 
     def _refresh(self, force: bool = False):
         now = time.time()
-        if not force and self._replicas and now - self._last_refresh < 5.0:
+        if not force and self._replicas:
             return
         controller = ray_tpu.get_actor(self.controller_name, namespace="serve")
         replicas = ray_tpu.get(
@@ -40,6 +77,7 @@ class Router:
             self._last_refresh = now
 
     def pick(self) -> tuple:
+        self._ensure_poller()
         self._refresh()
         with self._lock:
             n = len(self._replicas)
@@ -58,6 +96,20 @@ class Router:
         with self._lock:
             if idx in self._inflight and self._inflight[idx] > 0:
                 self._inflight[idx] -= 1
+
+    def evict(self, actor_hex: Optional[str]):
+        """Drop a dead replica from the local set IMMEDIATELY (ref:
+        router.py on-ActorDiedError eviction): a retry must not wait for
+        the controller's next health probe to stop targeting it. The
+        pushed replacement set supersedes this on arrival."""
+        if not actor_hex:
+            return
+        with self._lock:
+            keep = [r for r in self._replicas
+                    if r._actor_id.hex() != actor_hex]
+            if len(keep) != len(self._replicas):
+                self._replicas = keep
+                self._inflight = {i: 0 for i in range(len(keep))}
 
 
 class DeploymentHandle:
@@ -111,9 +163,11 @@ class DeploymentHandle:
                 router.done(idx)
                 return ref
             except (ray_tpu.exceptions.ActorDiedError,
-                    ray_tpu.exceptions.ActorUnavailableError):
+                    ray_tpu.exceptions.ActorUnavailableError) as e:
                 router.done(idx)
-                router._refresh(force=True)
+                router.evict(getattr(e, "actor_id", None))
+                if not router._replicas:
+                    router._refresh(force=True)
         raise RuntimeError(
             f"could not reach a replica of {self.deployment_name}")
 

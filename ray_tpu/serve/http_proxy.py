@@ -179,7 +179,7 @@ class HTTPProxy:
                                            daemon=True)
         self._refresher.start()
 
-    # ---- routing table (ref: long-poll push of route table; here pull) ----
+    # ---- routing table (ref: _private/long_poll.py push of route table) ----
 
     def _refresh_routes(self):
         try:
@@ -192,9 +192,25 @@ class HTTPProxy:
             self._routes = routes
 
     def _refresh_loop(self):
+        """Long-poll push: one pending controller call returns the new
+        route table the moment it changes (ref: long_poll.py:187); the
+        except path degrades to a 1 s retry while the controller is
+        down/restarting."""
+        gen = 0
         while True:
-            time.sleep(1.0)
-            self._refresh_routes()
+            try:
+                controller = ray_tpu.get_actor(self._controller_name,
+                                               namespace=_NAMESPACE)
+                res = ray_tpu.get(
+                    controller.long_poll.remote("routes", gen, 10.0),
+                    timeout=30)
+                changed = res["gen"] != gen
+                gen = res["gen"]
+                if changed and res["value"] is not None:
+                    with self._routes_lock:
+                        self._routes = res["value"]
+            except Exception:
+                time.sleep(1.0)
 
     def _resolve(self, path: str) -> tuple:
         """Longest-prefix match over route table."""
@@ -243,9 +259,32 @@ class HTTPProxy:
                 "stream_request").remote(req)
             return ("stream", "text/plain; charset=utf-8",
                     self._iter_chunks(gen))
-        ref = handle.remote(req)
-        result = ray_tpu.get(ref, timeout=60)
-        return _encode_result(result)
+        # Retry-on-dead-replica (ref: router.py assign-and-retry): a
+        # request that raced a replica death re-routes through the handle
+        # (whose router gets the replacement set pushed) instead of
+        # surfacing a 500. ActorDiedError cannot distinguish "queued,
+        # never started" from "died mid-execution", so only idempotent
+        # methods (GET/HEAD) are retried — re-running a POST whose
+        # replica died mid-write would duplicate its side effects.
+        last_err = None
+        attempts = 3 if h.command in ("GET", "HEAD") else 1
+        for _ in range(attempts):
+            ref = handle.remote(req)
+            try:
+                result = ray_tpu.get(ref, timeout=60)
+                return _encode_result(result)
+            except (ray_tpu.exceptions.ActorDiedError,
+                    ray_tpu.exceptions.ActorUnavailableError) as e:
+                last_err = e
+                router = handle._get_router()
+                # evict the EXACT dead replica locally — the controller's
+                # next health probe (and pushed update) may be up to a
+                # second away, and re-picking from a stale set would burn
+                # every retry on the same corpse
+                router.evict(getattr(e, "actor_id", None))
+                if not router._replicas:
+                    router._refresh(force=True)
+        raise last_err
 
     @staticmethod
     def _iter_chunks(gen):

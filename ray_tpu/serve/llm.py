@@ -377,7 +377,24 @@ class LLMEngine:
     def _ensure_paged_capacity(self, n: int) -> int:
         """Grow every active slot to hold n more tokens, preempting if
         the pool runs dry. Returns the usable n (0 if nothing active)."""
+        def pages_needed(n_try: int) -> int:
+            total = 0
+            for r in active:
+                if r.slot < 0:
+                    continue
+                need_tok = min(int(self._len_host[r.slot]) + n_try,
+                               self.max_seq)
+                need_pages = self.pool.pages_for(need_tok)
+                total += max(need_pages - len(self.pool.owned[r.slot]), 0)
+            return total
+
         def try_grow(n_try: int) -> bool:
+            # precheck against the pool so a doomed attempt allocates
+            # NOTHING: partial grants skew the halved retry's
+            # redistribution and can force an avoidable
+            # recompute-preemption right after pages were granted
+            if pages_needed(n_try) > self.pool.free_pages:
+                return False
             used_before = self.pool.used_pages
             ok = True
             for r in active:
@@ -445,7 +462,12 @@ class LLMEngine:
         if self.kv_layout == "paged":
             if self._ensure_paged_capacity(1) < 1:
                 for r in list(active_reqs):
-                    r.max_new_tokens = len(r.generated)  # page-capped
+                    # page-capped truncation is an ERROR the client must
+                    # see — a silent early finish is indistinguishable
+                    # from a complete generation
+                    r.max_new_tokens = len(r.generated)
+                    r.error = ("generation truncated: KV page pool "
+                               f"exhausted after {len(r.generated)} tokens")
                     self._maybe_finish(r)
                 return 0
             # capacity growth may have preempted a slot — re-snapshot
