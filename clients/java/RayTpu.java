@@ -141,6 +141,56 @@ public class RayTpu implements AutoCloseable {
         rpc("kill", p);
     }
 
+    /** Streaming-generator task: returns a stream id; items arrive one
+     *  per streamNext (null at exhaustion). */
+    public String taskStream(String func, List<Object> args)
+            throws IOException {
+        Map<String, Object> p = new LinkedHashMap<>();
+        p.put("func", func);
+        p.put("args", args);
+        Map<String, Object> opts = new LinkedHashMap<>();
+        opts.put("num_returns", "streaming");
+        p.put("opts", opts);
+        return (String) rpc("task", p).get("stream");
+    }
+
+    /** Next item of a stream, or null when exhausted. */
+    public Object streamNext(String stream) throws IOException {
+        Map<String, Object> p = new LinkedHashMap<>();
+        p.put("stream", stream);
+        Map<String, Object> r = rpc("stream_next", p);
+        if (Boolean.TRUE.equals(r.get("done"))) return null;
+        return r.get("value");
+    }
+
+    public void streamClose(String stream) throws IOException {
+        Map<String, Object> p = new LinkedHashMap<>();
+        p.put("stream", stream);
+        rpc("stream_close", p);
+    }
+
+    /** Placement group: bundles are resource maps, e.g. {"CPU": 0.5}. */
+    public String pgCreate(List<Object> bundles, String strategy)
+            throws IOException {
+        Map<String, Object> p = new LinkedHashMap<>();
+        p.put("bundles", bundles);
+        p.put("strategy", strategy);
+        return (String) rpc("pg_create", p).get("pg");
+    }
+
+    public boolean pgReady(String pg, double timeoutS) throws IOException {
+        Map<String, Object> p = new LinkedHashMap<>();
+        p.put("pg", pg);
+        p.put("timeout", timeoutS);
+        return Boolean.TRUE.equals(rpc("pg_ready", p).get("ready"));
+    }
+
+    public void pgRemove(String pg) throws IOException {
+        Map<String, Object> p = new LinkedHashMap<>();
+        p.put("pg", pg);
+        rpc("pg_remove", p);
+    }
+
     public void release(List<String> refs) throws IOException {
         Map<String, Object> p = new LinkedHashMap<>();
         p.put("refs", refs);
@@ -203,10 +253,14 @@ public class RayTpu implements AutoCloseable {
             if (v instanceof String) { str((String) v, sb); return; }
             if (v instanceof Boolean) { sb.append(v); return; }
             if (v instanceof Double || v instanceof Float) {
+                // Keep a decimal point so a Java double stays a Python
+                // float across the wire (2.0 must not arrive as int 2 —
+                // the caller chose a floating type; only Long/Integer
+                // inputs take the integer branch below).
                 double d = ((Number) v).doubleValue();
                 if (d == Math.floor(d) && !Double.isInfinite(d)
                         && Math.abs(d) < 1e15) {
-                    sb.append((long) d);
+                    sb.append((long) d).append(".0");
                 } else {
                     sb.append(d);
                 }

@@ -124,6 +124,48 @@ sub task {    # named python function "module:attr", args may embed refs
     return @refs == 1 ? $refs[0] : \@refs;
 }
 
+sub task_stream {    # streaming-generator task: returns a stream id
+    my ($self, $func, $args) = @_;
+    my $r = $self->_rpc("task", {
+        func => $func, args => ($args // []),
+        opts => { num_returns => "streaming" },
+    });
+    return $r->{stream};
+}
+
+sub stream_next {    # -> (done, value)
+    my ($self, $stream, %opt) = @_;
+    my $r = $self->_rpc("stream_next", {
+        stream => $stream, timeout => $opt{timeout} // 60,
+    });
+    return ($r->{done} ? 1 : 0, $r->{value});
+}
+
+sub stream_close {
+    my ($self, $stream) = @_;
+    $self->_rpc("stream_close", { stream => $stream });
+}
+
+sub pg_create {    # placement group over the wire
+    my ($self, $bundles, %opt) = @_;
+    my $r = $self->_rpc("pg_create", {
+        bundles => $bundles, strategy => $opt{strategy} // "PACK",
+    });
+    return $r->{pg};
+}
+
+sub pg_ready {
+    my ($self, $pg, %opt) = @_;
+    my $r = $self->_rpc("pg_ready", { pg => $pg,
+                                      timeout => $opt{timeout} // 30 });
+    return $r->{ready} ? 1 : 0;
+}
+
+sub pg_remove {
+    my ($self, $pg) = @_;
+    $self->_rpc("pg_remove", { pg => $pg });
+}
+
 sub actor {
     my ($self, $cls, $args, %opt) = @_;
     my @wire = @{ $args // [] };

@@ -41,6 +41,26 @@ my $top = $c->get($c->call($counter, "most_common"));
 printf("counter: %s=%d\n", $top->[0][0], $top->[0][1]);
 $c->kill_actor($counter);
 
+# streaming generator task: items arrive one per stream_next
+my $stream = $c->task_stream("builtins:range", [3]);
+my $streamed = 0;
+while (1) {
+    my ($done, $item) = $c->stream_next($stream);
+    last if $done;
+    $streamed++;
+}
+printf("streamed %d items\n", $streamed);
+
+# placement group: reserve a bundle, schedule into it
+my $pg = $c->pg_create([{ CPU => 0.5 }]);
+die "pg never ready" unless $c->pg_ready($pg, timeout => 30);
+my $pid_ref = $c->task("os:getpid", [],
+                       opts => { placement_group => $pg,
+                                 placement_group_bundle_index => 0,
+                                 num_cpus => 0.5 });
+printf("pg task pid=%d\n", $c->get($pid_ref));
+$c->pg_remove($pg);
+
 my $res = $c->cluster_resources();
 printf("cluster CPU: %g\n", $res->{CPU} // 0);
 print("OK\n");

@@ -38,6 +38,27 @@ int main(int argc, char** argv) {
   printf("counter: %s\n", top.dump().c_str());
   c.Kill(counter);
 
+  // streaming generator: items arrive one per StreamNext
+  auto s = c.TaskStream("builtins:range", {Json(3)});
+  int streamed = 0;
+  Json item;
+  while (c.StreamNext(s, &item)) streamed++;
+  printf("streamed %d items\n", streamed);
+
+  // placement group: reserve bundles, schedule into them
+  auto pg = c.PgCreate({Json(JsonObject{{"CPU", Json(0.5)}})});
+  if (!c.PgReady(pg, 30.0)) {
+    fprintf(stderr, "pg never became ready\n");
+    return 1;
+  }
+  raytpu::TaskOptions opts;
+  opts.num_cpus = 0.5;
+  opts.extra["placement_group"] = Json(pg.hex());
+  opts.extra["placement_group_bundle_index"] = Json(0);
+  auto pid = c.Task("os:getpid", {}, opts);
+  printf("pg task pid=%lld\n", (long long)c.Get(pid).as_int());
+  c.PgRemove(pg);
+
   printf("cluster: %s\n", Json(c.ClusterResources()).dump().c_str());
   printf("OK\n");
   return 0;

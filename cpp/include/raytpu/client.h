@@ -37,6 +37,26 @@ class ObjectRef {
   std::string hex_;
 };
 
+class Stream {
+ public:
+  Stream() = default;
+  explicit Stream(std::string id) : id_(std::move(id)) {}
+  const std::string& id() const { return id_; }
+
+ private:
+  std::string id_;
+};
+
+class PlacementGroup {
+ public:
+  PlacementGroup() = default;
+  explicit PlacementGroup(std::string hex) : hex_(std::move(hex)) {}
+  const std::string& hex() const { return hex_; }
+
+ private:
+  std::string hex_;
+};
+
 class ActorHandle {
  public:
   ActorHandle() = default;
@@ -52,6 +72,10 @@ struct TaskOptions {
   double num_cpus = -1;       // <0 = default
   JsonObject resources;       // e.g. {"TPU": Json(1)}
   int max_retries = -1;       // <0 = default
+  // Extra gateway options merged verbatim into opts: name, namespace,
+  // max_restarts, placement_group (PlacementGroup::hex()),
+  // placement_group_bundle_index, ...
+  JsonObject extra;
 };
 
 class Client {
@@ -93,6 +117,21 @@ class Client {
   ActorHandle GetActor(const std::string& name,
                        const std::string& ns = "default");
   void Kill(const ActorHandle& actor);
+
+  // Streaming-generator calls (server-side python generator; items
+  // arrive one per StreamNext). StreamNext returns false at exhaustion.
+  Stream CallStream(const ActorHandle& actor, const std::string& method,
+                    const JsonArray& args = {});
+  Stream TaskStream(const std::string& func, const JsonArray& args = {});
+  bool StreamNext(const Stream& s, Json* out, double timeout_s = 60.0);
+  void StreamClose(const Stream& s);
+
+  // Placement groups (bundles: array of {"CPU": n, ...} objects). Pass
+  // pg.hex() as opts.placement_group via TaskOptions::extra.
+  PlacementGroup PgCreate(const JsonArray& bundles,
+                          const std::string& strategy = "PACK");
+  bool PgReady(const PlacementGroup& pg, double timeout_s = 30.0);
+  void PgRemove(const PlacementGroup& pg);
 
   // Drop gateway-held references so the cluster can reclaim objects.
   void Release(const std::vector<ObjectRef>& refs);

@@ -112,6 +112,7 @@ std::vector<ObjectRef> Client::TaskN(const std::string& func,
   if (opts.num_cpus >= 0) o["num_cpus"] = Json(opts.num_cpus);
   if (!opts.resources.empty()) o["resources"] = Json(opts.resources);
   if (opts.max_retries >= 0) o["max_retries"] = Json(opts.max_retries);
+  for (const auto& kv : opts.extra) o[kv.first] = kv.second;
   Json r = Invoke("task", {{"func", Json(func)},
                            {"args", Json(args)},
                            {"opts", Json(o)}});
@@ -170,6 +171,53 @@ ActorHandle Client::GetActor(const std::string& name, const std::string& ns) {
 
 void Client::Kill(const ActorHandle& actor) {
   Invoke("kill", {{"actor", Json(actor.hex())}});
+}
+
+Stream Client::CallStream(const ActorHandle& actor,
+                          const std::string& method,
+                          const JsonArray& args) {
+  JsonObject p{{"actor", Json(actor.hex())},
+               {"method", Json(method)},
+               {"args", Json(args)},
+               {"num_returns", Json(std::string("streaming"))}};
+  Json r = Invoke("actor_call", p);
+  return Stream(r["stream"].as_string());
+}
+
+Stream Client::TaskStream(const std::string& func, const JsonArray& args) {
+  JsonObject o{{"num_returns", Json(std::string("streaming"))}};
+  JsonObject p{{"func", Json(func)}, {"args", Json(args)},
+               {"opts", Json(o)}};
+  Json r = Invoke("task", p);
+  return Stream(r["stream"].as_string());
+}
+
+bool Client::StreamNext(const Stream& s, Json* out, double timeout_s) {
+  JsonObject p{{"stream", Json(s.id())}, {"timeout", Json(timeout_s)}};
+  Json r = Invoke("stream_next", p);
+  if (r["done"].as_bool()) return false;
+  if (out != nullptr) *out = r["value"];
+  return true;
+}
+
+void Client::StreamClose(const Stream& s) {
+  Invoke("stream_close", JsonObject{{"stream", Json(s.id())}});
+}
+
+PlacementGroup Client::PgCreate(const JsonArray& bundles,
+                                const std::string& strategy) {
+  JsonObject p{{"bundles", Json(bundles)}, {"strategy", Json(strategy)}};
+  Json r = Invoke("pg_create", p);
+  return PlacementGroup(r["pg"].as_string());
+}
+
+bool Client::PgReady(const PlacementGroup& pg, double timeout_s) {
+  JsonObject p{{"pg", Json(pg.hex())}, {"timeout", Json(timeout_s)}};
+  return Invoke("pg_ready", p)["ready"].as_bool();
+}
+
+void Client::PgRemove(const PlacementGroup& pg) {
+  Invoke("pg_remove", JsonObject{{"pg", Json(pg.hex())}});
 }
 
 void Client::Release(const std::vector<ObjectRef>& refs) {

@@ -60,6 +60,59 @@ class ClientActorHandle:
         return call
 
 
+class ClientStream:
+    """Iterator over a server-side streaming-generator call: each
+    __next__ pulls one yielded item over the wire (the gateway holds the
+    ObjectRefGenerator; values arrive already materialized)."""
+
+    def __init__(self, stream_id: str, client: "GatewayClient",
+                 timeout: float = 60.0):
+        self.stream_id = stream_id
+        self._client = client
+        self._timeout = timeout
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        r = self._client.call_raw("stream_next", stream=self.stream_id,
+                                  timeout=self._timeout, pickle_ok=True)
+        if r.get("done"):
+            self._done = True
+            raise StopIteration
+        return self._client._dec(r["value"])
+
+    def close(self):
+        if not self._done:
+            self._done = True
+            try:
+                self._client.call_raw("stream_close", stream=self.stream_id)
+            except Exception:
+                pass
+
+
+class ClientPlacementGroup:
+    """Client-side placement group (ref: Ray Client proxies
+    util.placement_group). Pass as opts={"placement_group": pg.hex} — or
+    use the GatewayClient helpers."""
+
+    __slots__ = ("hex", "_client")
+
+    def __init__(self, hex_id: str, client: "GatewayClient"):
+        self.hex = hex_id
+        self._client = client
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        return self._client.call_raw("pg_ready", pg=self.hex,
+                                     timeout=timeout)["ready"]
+
+    def table(self):
+        return self._client.call_raw("pg_table", pg=self.hex)["table"]
+
+
 def _pickled(obj) -> dict:
     import cloudpickle
 
@@ -173,17 +226,27 @@ class GatewayClient:
         return ([by_hex[h] for h in r["ready"]],
                 [by_hex[h] for h in r["pending"]])
 
+    def _norm_opts(self, opts):
+        if not opts:
+            return {}
+        o = dict(opts)
+        if isinstance(o.get("placement_group"), ClientPlacementGroup):
+            o["placement_group"] = o["placement_group"].hex
+        return o
+
     def task(self, fn, *args, opts: Optional[dict] = None, **kwargs):
         """Run a function on the cluster; fn may be any picklable callable
         or a "module:function" path string."""
         self._flush_releases()
         params = dict(args=[self._enc(a) for a in args],
                       kwargs={k: self._enc(v) for k, v in kwargs.items()},
-                      opts=opts or {})
+                      opts=self._norm_opts(opts))
         if isinstance(fn, str):
             r = self.call_raw("task", func=fn, **params)
         else:
             r = self.call_raw("task_pickled", func=_pickled(fn), **params)
+        if "stream" in r:
+            return ClientStream(r["stream"], self)
         refs = [ClientObjectRef(h, self) for h in r["refs"]]
         return refs[0] if len(refs) == 1 else refs
 
@@ -191,7 +254,7 @@ class GatewayClient:
         self._flush_releases()
         params = dict(args=[self._enc(a) for a in args],
                       kwargs={k: self._enc(v) for k, v in kwargs.items()},
-                      opts=opts or {})
+                      opts=self._norm_opts(opts))
         if isinstance(cls, str):
             r = self.call_raw("actor_create", cls=cls, **params)
         else:
@@ -205,6 +268,8 @@ class GatewayClient:
             args=[self._enc(a) for a in args],
             kwargs={k: self._enc(v) for k, v in kwargs.items()},
             num_returns=num_returns)
+        if "stream" in r:
+            return ClientStream(r["stream"], self)
         refs = [ClientObjectRef(h, self) for h in r["refs"]]
         return refs[0] if len(refs) == 1 else refs
 
@@ -214,6 +279,14 @@ class GatewayClient:
 
     def kill(self, handle: ClientActorHandle):
         self.call_raw("kill", actor=handle.hex)
+
+    def placement_group(self, bundles: List[Dict[str, float]],
+                        strategy: str = "PACK") -> ClientPlacementGroup:
+        r = self.call_raw("pg_create", bundles=bundles, strategy=strategy)
+        return ClientPlacementGroup(r["pg"], self)
+
+    def remove_placement_group(self, pg: ClientPlacementGroup):
+        self.call_raw("pg_remove", pg=pg.hex)
 
     def cluster_resources(self) -> Dict[str, float]:
         return self.call_raw("cluster_resources")

@@ -54,6 +54,17 @@ def _called_by_name(path: str, *args, **kwargs):
     return fn(*args, **kwargs)
 
 
+def _stream_by_name(path: str, *args, **kwargs):
+    """Streaming twin of _called_by_name: the named callable's result is
+    re-yielded item by item (a generator/iterable becomes a streaming
+    generator task; a scalar streams as one item)."""
+    out = _called_by_name(path, *args, **kwargs)
+    if hasattr(out, "__iter__") and not isinstance(out, (str, bytes, dict)):
+        yield from out
+    else:
+        yield out
+
+
 class _Codec:
     """JSON <-> python values with the extension markers above."""
 
@@ -141,6 +152,9 @@ class ClientGateway:
         self.host, self.port = host, port
         self.refs: Dict[str, Any] = {}
         self.actors: Dict[str, Any] = {}
+        self.pgs: Dict[str, Any] = {}      # hex -> PlacementGroup
+        self.streams: Dict[str, Any] = {}  # id -> ObjectRefGenerator iter
+        self._stream_ids = 0
         self.codec = _Codec(self.refs)
         # driver API calls block (ray_tpu.get); keep them off the loop
         self.pool = ThreadPoolExecutor(max_workers=16,
@@ -193,10 +207,38 @@ class ClientGateway:
     def _options(self, opts):
         out = {}
         for k in ("num_returns", "num_cpus", "resources", "max_retries",
-                  "runtime_env", "name", "max_restarts", "max_concurrency"):
+                  "runtime_env", "name", "namespace", "lifetime",
+                  "max_restarts", "max_task_retries", "max_concurrency"):
             if opts and k in opts:
                 out[k] = opts[k]
+        if opts and "placement_group" in opts:
+            # PG-aware scheduling over the wire (ref: Ray Client proxies
+            # PlacementGroupSchedulingStrategy the same way)
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy)
+
+            pg = self.pgs[opts["placement_group"]]
+            out["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                pg, opts.get("placement_group_bundle_index", -1))
         return out
+
+    def _track_result(self, refs, _session):
+        """Task/actor-call result: plain refs or a streaming generator."""
+        import ray_tpu
+
+        if isinstance(refs, ray_tpu.ObjectRefGenerator):
+            self._stream_ids += 1
+            sid = f"s{self._stream_ids}"
+            # generator + explicit cursor (NOT a bare iterator): item
+            # fetches go through next_stream_ref with a bounded timeout,
+            # and the cursor advances only after a successful delivery —
+            # a timed-out pull can be retried without losing the item
+            self.streams[sid] = {"gen": refs, "index": 0}
+            if _session is not None:
+                _session["streams"].add(sid)
+            return {"stream": sid}
+        refs = refs if isinstance(refs, list) else [refs]
+        return {"refs": self._track_refs(_session, refs)}
 
     def m_task(self, func: str = None, args=None, kwargs=None, opts=None,
                _session=None):
@@ -206,13 +248,14 @@ class ClientGateway:
 
         args = [self.codec.decode(a) for a in (args or [])]
         kwargs = {k: self.codec.decode(v) for k, v in (kwargs or {}).items()}
-        rf = ray_tpu.remote(_called_by_name)
+        streaming = (opts or {}).get("num_returns") == "streaming"
+        rf = ray_tpu.remote(_stream_by_name if streaming
+                            else _called_by_name)
         o = self._options(opts)
         if o:
             rf = rf.options(**o)
-        refs = rf.remote(func, *args, **kwargs)
-        refs = refs if isinstance(refs, list) else [refs]
-        return {"refs": self._track_refs(_session, refs)}
+        return self._track_result(rf.remote(func, *args, **kwargs),
+                                  _session)
 
     def m_task_pickled(self, func=None, args=None, kwargs=None, opts=None,
                        _session=None):
@@ -227,9 +270,7 @@ class ClientGateway:
         o = self._options(opts)
         if o:
             rf = rf.options(**o)
-        refs = rf.remote(*args, **kwargs)
-        refs = refs if isinstance(refs, list) else [refs]
-        return {"refs": self._track_refs(_session, refs)}
+        return self._track_result(rf.remote(*args, **kwargs), _session)
 
     def _register_actor(self, handle, session=None, owned=False):
         h = handle._actor_id.hex()
@@ -267,9 +308,7 @@ class ClientGateway:
         m = getattr(handle, method)
         if num_returns != 1:
             m = m.options(num_returns=num_returns)
-        refs = m.remote(*args, **kwargs)
-        refs = refs if isinstance(refs, list) else [refs]
-        return {"refs": self._track_refs(_session, refs)}
+        return self._track_result(m.remote(*args, **kwargs), _session)
 
     def m_get_actor(self, name: str = None, namespace: str = "default",
                     _session=None):
@@ -295,6 +334,94 @@ class ClientGateway:
                 _session["refs"].discard(h)
         return {"ok": True}
 
+    def m_stream_next(self, stream: str = None, timeout: float = 60.0,
+                      pickle_ok=False, _session=None):
+        """Pull the next item of a streaming-generator call (ref: Ray
+        Client has no streaming surface — this closes that gap for all
+        gateway languages). Returns {"done": true} at exhaustion."""
+        import ray_tpu
+
+        st = self.streams.get(stream)
+        if st is None:
+            raise KeyError(f"unknown stream {stream!r}")
+        from ray_tpu.core import runtime as _rt
+        from ray_tpu.core.status import GetTimeoutError
+
+        gen, idx = st["gen"], st["index"] + 1
+        # bounded wait that does NOT consume on timeout: GetTimeoutError
+        # propagates to the client, which may simply call again — unlike
+        # next(it), the cursor only moves after a successful delivery,
+        # and a slow stream can't park a pool thread forever
+        try:
+            ref = _rt.get_runtime().next_stream_ref(gen.task_id, idx,
+                                                    timeout=timeout)
+            ended = ref is None
+            value = None if ended else ray_tpu.get(ref)  # ready: no wait
+        except GetTimeoutError:
+            raise                          # retryable: cursor unmoved
+        except Exception:
+            self.streams.pop(stream, None)  # stream errored: surface it
+            if _session is not None:
+                _session["streams"].discard(stream)
+            raise
+        if ended:
+            self.streams.pop(stream, None)
+            if _session is not None:
+                _session["streams"].discard(stream)
+            return {"done": True}
+        st["index"] = idx
+        return {"done": False,
+                "value": self.codec.encode(value, pickle_fallback=pickle_ok)}
+
+    def m_stream_close(self, stream: str = None, _session=None):
+        self.streams.pop(stream, None)
+        if _session is not None:
+            _session["streams"].discard(stream)
+        return {"ok": True}
+
+    def m_pg_create(self, bundles=None, strategy: str = "PACK",
+                    _session=None):
+        """Placement groups over the wire (ref: Ray Client proxies
+        util.placement_group the same way)."""
+        from ray_tpu.util.placement_group import placement_group
+
+        pg = placement_group(bundles, strategy=strategy)
+        h = pg.id.hex()
+        self.pgs[h] = pg
+        if _session is not None:
+            _session["pgs"].add(h)
+        return {"pg": h}
+
+    def m_pg_ready(self, pg: str = None, timeout: float = 30.0,
+                   _session=None):
+        return {"ready": bool(self.pgs[pg].ready(timeout=timeout))}
+
+    def m_pg_table(self, pg: str = None, _session=None):
+        def jsonable(v):
+            if hasattr(v, "hex") and callable(getattr(v, "hex", None)) \
+                    and not isinstance(v, (str, bytes, float)):
+                return v.hex()          # BaseID subclasses
+            if hasattr(v, "quantities"):
+                return dict(v.quantities)   # ResourceSet
+            if isinstance(v, dict):
+                return {str(k): jsonable(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [jsonable(x) for x in v]
+            if v is None or isinstance(v, (bool, int, float, str)):
+                return v
+            return repr(v)
+        return {"table": jsonable(self.pgs[pg].table())}
+
+    def m_pg_remove(self, pg: str = None, _session=None):
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        g = self.pgs.pop(pg, None)
+        if _session is not None:
+            _session["pgs"].discard(pg)
+        if g is not None:
+            remove_placement_group(g)
+        return {"ok": True}
+
     def _close_session(self, session):
         """Connection teardown: release the session's refs and kill its
         unnamed actors (ref: Ray Client per-client driver teardown)."""
@@ -309,13 +436,26 @@ class ClientGateway:
                     ray_tpu.kill(handle)
                 except Exception:
                     pass
+        for sid in session["streams"]:
+            self.streams.pop(sid, None)
+        for h in session["pgs"]:
+            g = self.pgs.pop(h, None)
+            if g is not None:
+                try:
+                    from ray_tpu.util.placement_group import (
+                        remove_placement_group)
+
+                    remove_placement_group(g)
+                except Exception:
+                    pass
 
     # ----------------------------------------------------------------- serve
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
         loop = asyncio.get_running_loop()
-        session = {"refs": set(), "actors": set()}
+        session = {"refs": set(), "actors": set(), "streams": set(),
+                   "pgs": set()}
         try:
             while True:
                 try:
@@ -341,7 +481,14 @@ class ClientGateway:
                     logger.debug("gateway method failed", exc_info=True)
                     out = {"id": mid, "ok": False,
                            "error": f"{type(e).__name__}: {e}"}
-                data = json.dumps(out).encode()
+                try:
+                    data = json.dumps(out).encode()
+                except TypeError as e:
+                    # a method returned something non-JSON: surface the
+                    # error to the caller instead of killing the stream
+                    out = {"id": mid, "ok": False,
+                           "error": f"unserializable result: {e}"}
+                    data = json.dumps(out).encode()
                 writer.write(_LEN.pack(len(data)) + data)
                 await writer.drain()
         finally:

@@ -149,6 +149,8 @@ def test_perl_client_end_to_end(gateway):
     assert "math:floor(ref) = 5" in out
     assert "wait: 3 ready 0 pending" in out
     assert "counter: tpu=3" in out
+    assert "streamed 3 items" in out
+    assert "pg task pid=" in out
     assert "OK" in out
 
 
@@ -213,4 +215,102 @@ def test_java_client_end_to_end(gateway):
     assert "math:floor(ref) = 5" in out
     assert "wait: 3 ready 0 pending" in out
     assert "counter: tpu=3" in out
+    assert "streamed 3 items" in out
+    assert "pg task pid=" in out
     assert "OK" in out
+
+
+def test_client_streaming_generator(gateway):
+    """Streaming generators over the gateway (VERDICT r3 item 9): a
+    server-side generator's items arrive one at a time over the wire."""
+    from ray_tpu import client
+
+    c = client.connect(("127.0.0.1", gateway.port))
+    try:
+        def gen(n):
+            for i in range(n):
+                yield {"i": i, "sq": i * i}
+
+        stream = c.task(gen, 4, opts={"num_returns": "streaming"})
+        items = list(stream)
+        assert items == [{"i": i, "sq": i * i} for i in range(4)]
+
+        # early close releases the server-side generator
+        s2 = c.task(gen, 100, opts={"num_returns": "streaming"})
+        assert next(s2)["i"] == 0
+        s2.close()
+    finally:
+        c.disconnect()
+
+
+def test_client_streaming_actor_method(gateway):
+    from ray_tpu import client
+
+    c = client.connect(("127.0.0.1", gateway.port))
+    try:
+        class Streamer:
+            def counts(self, n):
+                for i in range(n):
+                    yield i * 2
+
+        a = c.actor(Streamer)
+        out = list(c.actor_call(a, "counts", 3,
+                                num_returns="streaming"))
+        assert out == [0, 2, 4]
+        c.kill(a)
+    finally:
+        c.disconnect()
+
+
+def test_client_placement_groups(gateway):
+    """Placement groups over the gateway (VERDICT r3 item 9)."""
+    from ray_tpu import client
+
+    c = client.connect(("127.0.0.1", gateway.port))
+    try:
+        pg = c.placement_group([{"CPU": 0.5}, {"CPU": 0.5}],
+                               strategy="PACK")
+        assert pg.ready(timeout=30)
+        table = pg.table()
+        assert table is not None
+
+        # schedule a task into bundle 0 of the PG
+        ref = c.task("os:getpid",
+                     opts={"placement_group": pg,
+                           "placement_group_bundle_index": 0,
+                           "num_cpus": 0.5})
+        assert isinstance(c.get(ref), int)
+        c.remove_placement_group(pg)
+    finally:
+        c.disconnect()
+
+
+def test_client_named_actors_namespace(gateway):
+    """Named actors + namespaces + restart options over the gateway."""
+    from ray_tpu import client
+
+    c = client.connect(("127.0.0.1", gateway.port))
+    try:
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c.actor(Counter, opts={"name": "gw_counter", "namespace": "gwtest",
+                               "max_restarts": 1})
+        # second client resolves it by name+namespace
+        c2 = client.connect(("127.0.0.1", gateway.port))
+        try:
+            h = c2.get_actor("gw_counter", namespace="gwtest")
+            assert c2.get(c2.actor_call(h, "incr")) == 1
+            assert c2.get(c2.actor_call(h, "incr")) == 2
+        finally:
+            c2.disconnect()
+        h = c.get_actor("gw_counter", namespace="gwtest")
+        assert c.get(c.actor_call(h, "incr")) == 3
+        c.kill(h)
+    finally:
+        c.disconnect()
