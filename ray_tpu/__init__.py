@@ -119,10 +119,11 @@ def init(address: Optional[str] = None, *,
                 raise RuntimeError(f"no alive nodes at {address}")
             nodelet_addr = alive[0].nodelet_addr
             store_name = alive[0].store_name
+            node_id_hex = alive[0].node_id.hex()
 
         job_id = JobID.from_random()
         runtime = _rt.Runtime(cfg, gcs_addr, nodelet_addr, store_name, job_id,
-                              mode="driver")
+                              mode="driver", node_id=node_id_hex)
         _rt.set_runtime(runtime)
         runtime.start()
         if runtime_env:
@@ -223,9 +224,46 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     _rt.get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False):
-    raise NotImplementedError(
-        "task cancellation lands with the cancellation milestone")
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = False):
+    """Cancel a task (ref: ray.cancel): queued tasks are dropped; an
+    executing task gets KeyboardInterrupt (force=True kills its worker).
+    The ref's get raises TaskCancelledError. Finished tasks: no-op."""
+    _rt.get_runtime().cancel(ref, force=force, recursive=recursive)
+
+
+class RuntimeContext:
+    """Where am I running? (ref: python/ray/runtime_context.py
+    RuntimeContext — get_node_id/get_job_id/get_task_id/get_worker_id).
+    Snapshot at call time; fetch a fresh one per query."""
+
+    def __init__(self, rt):
+        self.node_id = rt.node_id
+        self.job_id = rt.job_id.hex()
+        self.worker_id = (rt.worker_id.hex()
+                          if isinstance(rt.worker_id, bytes)
+                          else str(rt.worker_id))
+        # exec-context only: None outside a task, like the reference's
+        # get_task_id (get_current_task_id falls back to the synthetic
+        # driver task id, which is for put-id spaces, not user context)
+        tid = getattr(rt._exec_ctx, "task_id", None)
+        self.task_id = tid.hex() if tid is not None else None
+        self.worker_mode = rt.mode
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    def get_job_id(self) -> str:
+        return self.job_id
+
+    def get_task_id(self):
+        return self.task_id
+
+    def get_worker_id(self) -> str:
+        return self.worker_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_rt.get_runtime())
 
 
 def nodes() -> List[dict]:
@@ -295,6 +333,7 @@ def timeline(limit: int = 1000) -> List[dict]:
 
 __all__ = [
     "init", "shutdown", "remote", "put", "get", "wait", "kill", "cancel",
+    "get_runtime_context",
     "method", "get_actor", "nodes", "cluster_resources", "available_resources",
     "timeline", "stack", "internal_stats",
     "ObjectRef", "ObjectRefGenerator", "ActorHandle", "exceptions", "is_initialized",

@@ -326,8 +326,21 @@ class TaskSpec:
         from ray_tpu.runtime_env import process_env
 
         pe = tuple(sorted(process_env(self.runtime_env).items()))
+        # Placement-TARGETED strategies must key the class by their
+        # target: lease reuse would otherwise hand a task affined to
+        # node B the parked worker leased on node A (observed: every
+        # NodeAffinity broadcast task ran on the driver's node), and a
+        # PG task the wrong bundle's worker.
+        target = ()
+        if self.scheduling.kind == "NODE_AFFINITY":
+            nid = self.scheduling.node_id
+            target = (nid.hex() if nid is not None else None,
+                      self.scheduling.soft)
+        elif self.scheduling.kind == "PLACEMENT_GROUP":
+            target = (self.scheduling.pg_id.hex(),
+                      self.scheduling.bundle_index)
         return (self.func_id, tuple(sorted(self.resources.quantities.items())),
-                self.scheduling.kind, pe)
+                self.scheduling.kind, target, pe)
 
 
 @dataclass

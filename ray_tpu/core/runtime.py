@@ -46,7 +46,8 @@ from ray_tpu.core.refcount import ReferenceCounter
 from ray_tpu.core.rpc import (ClientPool, ConnectionLost, EventLoopThread,
                               RemoteError, RpcServer)
 from ray_tpu.core.status import (ActorDiedError, ActorUnavailableError,
-                                 GetTimeoutError, ObjectLostError, TaskError,
+                                 GetTimeoutError, ObjectLostError,
+                                 TaskCancelledError, TaskError,
                                  WorkerCrashedError)
 from ray_tpu.runtime_env import process_env as _process_env
 
@@ -213,7 +214,8 @@ class Runtime:
     def __init__(self, cfg: Config, gcs_addr: Address, nodelet_addr: Address,
                  store_name: str, job_id: JobID, mode: str = "driver",
                  loop: Optional[asyncio.AbstractEventLoop] = None,
-                 worker_id: Optional[bytes] = None):
+                 worker_id: Optional[bytes] = None,
+                 node_id: Optional[str] = None):
         self.cfg = cfg
         self.mode = mode
         self.job_id = job_id
@@ -221,6 +223,7 @@ class Runtime:
         self.gcs_addr = tuple(gcs_addr)
         self.nodelet_addr = tuple(nodelet_addr)
         self.store_name = store_name
+        self.node_id = node_id    # hex of the co-located nodelet's node
 
         if loop is None:
             self.loop_thread: Optional[EventLoopThread] = EventLoopThread()
@@ -263,6 +266,11 @@ class Runtime:
         self._class_parked: Dict[Tuple, int] = defaultdict(int)
         self._class_work: Dict[Tuple, asyncio.Event] = {}
         self._inflight: Dict[TaskID, _PendingTask] = {}
+        # cancellation state: executing task -> worker addr (set around
+        # the push), and ids whose cancel was requested (suppresses the
+        # crash-retry path when force-cancel kills the worker)
+        self._task_worker: Dict[TaskID, Address] = {}
+        self._cancel_requested: Set[TaskID] = set()
         # streaming-generator tasks owned here (ref: task_manager.h:143-171)
         self._streams: Dict[TaskID, _StreamState] = {}
         self._stream_lock = threading.Lock()
@@ -1434,6 +1442,13 @@ class Runtime:
                     finally:
                         self._class_parked[cls] -= 1
                     continue
+                if spec.task_id in self._cancel_requested:
+                    # cancelled in the window between queue-pop and push
+                    self._cancel_requested.discard(spec.task_id)
+                    self._fail_task_returns(spec, TaskCancelledError(
+                        f"task {spec.name} cancelled before execution"))
+                    self._record_event(spec, "CANCELLED")
+                    continue
                 if not await self._push_and_handle(spec, lw, cls):
                     break     # worker died; retries repump on a fresh lease
         finally:
@@ -1520,14 +1535,12 @@ class Runtime:
                                            resources=spec.resources)
             if t is not None:
                 target = t
+        affinity_addr = None
         if spec.scheduling.kind == "NODE_AFFINITY":
-            r = await self.pool.get(self.gcs_addr).call(
-                "pick_node", resources=spec.resources, strategy_kind="DEFAULT")
-            # affinity handled by GCS in actor path; tasks: resolve node addr
             nodes = await self.pool.get(self.gcs_addr).call("get_nodes")
             for n in nodes:
                 if n.node_id == spec.scheduling.node_id:
-                    target = n.nodelet_addr
+                    affinity_addr = target = tuple(n.nodelet_addr)
                     break
         deadline = time.time() + self.cfg.worker_lease_timeout_s * 4
         while time.time() < deadline:
@@ -1540,7 +1553,12 @@ class Runtime:
                     timeout=self.cfg.worker_lease_timeout_s + 10.0)
             except (ConnectionLost, RemoteError, OSError) as e:
                 logger.warning("lease request to %s failed: %s", target, e)
-                target = self.nodelet_addr
+                if affinity_addr is not None and not spec.scheduling.soft:
+                    # hard affinity: a transient RPC failure must not
+                    # quietly re-target the driver's node
+                    target = affinity_addr
+                else:
+                    target = self.nodelet_addr
                 await asyncio.sleep(0.2)
                 continue
             st = r["status"]
@@ -1548,6 +1566,12 @@ class Runtime:
                 return _LeasedWorker(r["lease_id"], r["worker_addr"], tuple(target),
                                      r["worker_id"])
             if st == "spillback":
+                if affinity_addr is not None and not spec.scheduling.soft:
+                    # hard affinity (ref: NodeAffinitySchedulingStrategy
+                    # soft=False): the task runs on ITS node or not at
+                    # all — never follow a spillback elsewhere
+                    await asyncio.sleep(0.1)
+                    continue
                 target = tuple(r["addr"])
                 continue
             if st == "retry":
@@ -1565,6 +1589,8 @@ class Runtime:
                         pg[0], pg[1], resources=spec.resources,
                         refresh=True)
                     target = t if t is not None else self.nodelet_addr
+                elif affinity_addr is not None and not spec.scheduling.soft:
+                    target = affinity_addr   # hard affinity: wait it out
                 else:
                     target = self.nodelet_addr
                 continue
@@ -1596,12 +1622,18 @@ class Runtime:
         is dead (the caller must abandon this lease; retries are re-enqueued
         and repumped onto a fresh lease)."""
         self._record_event(spec, "RUNNING", worker=lw.worker_id.hex()[:12])
+        self._task_worker[spec.task_id] = lw.worker_addr
         try:
             result: TaskResult = await self.pool.get(lw.worker_addr).call(
                 "push_task", spec=spec)
         except (ConnectionLost, RemoteError, OSError) as e:
             pt = self._inflight.get(spec.task_id)
-            if pt is not None and pt.retries_left > 0:
+            if spec.task_id in self._cancel_requested:
+                # force-cancel killed the worker under this push: that's
+                # cancellation, not a crash — never retried
+                self._fail_task_returns(spec, TaskCancelledError(
+                    f"task {spec.name} cancelled (force)"))
+            elif pt is not None and pt.retries_left > 0:
                 pt.retries_left -= 1
                 logger.warning("task %s worker died (%s); retrying (%d left)",
                                spec.name, e, pt.retries_left)
@@ -1612,12 +1644,16 @@ class Runtime:
                 self._fail_task_returns(spec, WorkerCrashedError(
                     f"worker died running {spec.name}: {e}"))
             return False
+        finally:
+            self._task_worker.pop(spec.task_id, None)
+            self._cancel_requested.discard(spec.task_id)
         self._complete_task(spec, result, cls,
                             worker=lw.worker_id.hex()[:12])
         return True
 
     def _complete_task(self, spec: TaskSpec, result: TaskResult,
                        cls: Optional[Tuple], worker: Optional[str] = None):
+        self._cancel_requested.discard(spec.task_id)   # no leak on any path
         app_error = None
         for kind, payload in result.returns:
             if kind == "err":
@@ -1942,6 +1978,65 @@ class Runtime:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.gcs_call("kill_actor", actor_id=actor_id, no_restart=no_restart)
+
+    def cancel(self, ref, force: bool = False, recursive: bool = False):
+        """ref: CoreWorker::CancelTask (core_worker.cc) — three cases:
+        still QUEUED: drop from the submit queue, fail returns with
+        TaskCancelledError. EXECUTING: inject KeyboardInterrupt into the
+        worker's executor thread (force=True: tell the worker to exit —
+        the interpreter dies, so even C-blocked tasks stop). Already
+        FINISHED: no-op. Cancelled tasks are never retried. Actor-call
+        refs route the interrupt to the actor's worker process (sync
+        methods only — an async method coroutine has no thread to
+        interrupt). A task grabbed by a pump but not yet pushed is
+        caught at the pre-push _cancel_requested gate."""
+        if recursive:
+            raise NotImplementedError(
+                "recursive cancellation is not implemented; cancel child "
+                "task refs individually")
+        oid = ref.id
+        task_id = oid.task_id()
+        pt = self._inflight.get(task_id)
+        if pt is None:
+            return   # finished (or not a task ref): nothing to cancel
+        self._cancel_requested.add(task_id)
+        # 1. queued, not yet leased: remove + fail (cheapest path)
+        for cls, q in list(self._queues.items()):
+            for spec in list(q):
+                if spec.task_id == task_id:
+                    try:
+                        q.remove(spec)
+                    except ValueError:
+                        break    # a pump grabbed it; fall through to 2.
+                    self._cancel_requested.discard(task_id)
+                    self._fail_task_returns(spec, TaskCancelledError(
+                        f"task {spec.name} cancelled before execution"))
+                    self._record_event(spec, "CANCELLED")
+                    return
+        # 2. executing on a worker. Actor calls aren't in _task_worker —
+        # resolve their worker through the actor address table.
+        addr = self._task_worker.get(task_id)
+        if addr is None and pt.spec is not None and pt.spec.is_actor_call:
+            addr = self._actor_addr.get(task_id.actor_id())
+        if addr is None:
+            # not queued, not yet pushed: the pre-push gate in the pump
+            # fires on _cancel_requested; or already completed (the
+            # completion path clears the flag)
+            return
+        if force:
+            # kill the worker process; _push_and_handle sees the broken
+            # push + _cancel_requested and fails with TaskCancelledError
+            try:
+                self._run(self.pool.get(tuple(addr)).call(
+                    "exit_worker", reason="cancelled (force)", timeout=5.0))
+            except Exception:
+                pass
+        else:
+            try:
+                self._run(self.pool.get(tuple(addr)).call(
+                    "cancel_task", task_id=task_id, timeout=10.0))
+            except Exception:
+                pass
 
     # -------------------------------------------- ownership protocol (server)
 

@@ -46,6 +46,10 @@ class ActorUnavailableError(RayTpuError):
     """Actor is restarting; call may be retried (ref: ActorUnavailableError)."""
 
 
+class TaskCancelledError(RayTpuError):
+    """Task was cancelled via ray_tpu.cancel (ref: TaskCancelledError)."""
+
+
 class ObjectLostError(RayTpuError):
     """Object's value was lost and could not be reconstructed
     (ref: ObjectLostError / ObjectReconstructionFailedError)."""

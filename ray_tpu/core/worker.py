@@ -42,6 +42,12 @@ class Worker:
         self.actor_instance: Any = None
         self.actor_spec: Optional[TaskSpec] = None
         self._async_sem: Optional[asyncio.Semaphore] = None
+        # cancellation (ref: core worker CancelTask -> KeyboardInterrupt
+        # in the executing thread): task_id -> executing thread ident,
+        # plus the set of ids whose interrupt means CANCELLED, not ctrl-C
+        self._exec_threads: dict = {}
+        self._cancelled: set = set()
+        self._cancel_lock = threading.Lock()
 
     def __getattr__(self, name):
         # Delegate rpc_wait_object / rpc_locate / rpc_add_borrow / ... to the
@@ -169,6 +175,8 @@ class Worker:
         env = spec.runtime_env or (self.actor_spec.runtime_env
                                    if self.actor_spec else None)
         self.runtime.set_exec_context(spec.task_id, runtime_env=env)
+        with self._cancel_lock:
+            self._exec_threads[spec.task_id] = threading.get_ident()
         try:
             from ray_tpu.util.tracing import continue_trace
 
@@ -188,10 +196,24 @@ class Worker:
             return self._package_returns(spec, value)
         except BaseException as e:
             tb = traceback.format_exc()
-            ser = SerializedException(e, tb)
+            with self._cancel_lock:
+                was_cancelled = spec.task_id in self._cancelled
+            if was_cancelled and isinstance(e, KeyboardInterrupt):
+                # the interrupt was OUR injected cancellation, not ctrl-C
+                from ray_tpu.core.status import TaskCancelledError
+
+                ser = SerializedException(
+                    TaskCancelledError(
+                        f"task {spec.name} cancelled while running"),
+                    tb, wrap=False)
+            else:
+                ser = SerializedException(e, tb)
             return TaskResult(spec.task_id,
                               [("err", ser)] * max(1, spec.num_returns))
         finally:
+            with self._cancel_lock:
+                self._exec_threads.pop(spec.task_id, None)
+                self._cancelled.discard(spec.task_id)
             self.runtime.clear_exec_context()
 
     # ------------------------------------------------------------ rpc surface
@@ -301,6 +323,32 @@ class Worker:
         self.runtime.flush_task_events()
         return result
 
+    async def rpc_cancel_task(self, task_id: TaskID) -> dict:
+        """Cancel an executing task by injecting KeyboardInterrupt into
+        its executor thread (ref: core worker CancelTask -> SIGINT in the
+        worker). The interrupt lands at the next bytecode boundary; a
+        task blocked in C (e.g. a long XLA compile) is interrupted when
+        it returns to Python — same limitation as the reference."""
+        import ctypes
+
+        with self._cancel_lock:
+            # inject UNDER the lock: _execute's finally pops the entry
+            # under this same lock, so a present entry proves the thread
+            # is still inside _execute for THIS task — the interrupt can
+            # never land in a pool thread that moved on to other work
+            # (or sits idle in queue.get, where a stray KI would kill
+            # the executor's only thread permanently)
+            ident = self._exec_threads.get(task_id)
+            if ident is None:
+                return {"status": "not_running"}
+            self._cancelled.add(task_id)
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(KeyboardInterrupt))
+            if n != 1:   # thread gone (cannot happen while entry present)
+                self._cancelled.discard(task_id)
+                return {"status": "not_running"}
+        return {"status": "cancelling"}
+
     async def rpc_dump_stacks(self) -> dict:
         """All-thread stack dump (ref: `ray stack` scripts.py:1789 —
         py-spy over workers; here the worker self-reports, no ptrace)."""
@@ -326,7 +374,8 @@ async def worker_main(args):
     loop = asyncio.get_running_loop()
     runtime = Runtime(cfg, (gh, int(gp)), (nh, int(np_)), args.store,
                       JobID.nil(), mode="worker", loop=loop,
-                      worker_id=bytes.fromhex(args.worker_id))
+                      worker_id=bytes.fromhex(args.worker_id),
+                      node_id=args.node_id)
     set_runtime(runtime)
     worker = Worker(runtime)
     runtime.server.handler = worker

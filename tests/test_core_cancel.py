@@ -1,0 +1,94 @@
+"""ray_tpu.cancel (ref: ray.cancel semantics, core_worker.cc CancelTask):
+queued tasks drop from the submit queue; executing tasks get
+KeyboardInterrupt injected (force=True kills the worker); finished tasks
+are a no-op; cancelled tasks never retry."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.status import TaskCancelledError
+
+
+def test_cancel_queued_task(ray_start_regular):
+    """A task parked behind a long-running one cancels without ever
+    executing."""
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(8)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def later():
+        return "ran"
+
+    h = hog.remote()
+    queued = later.remote()     # can't schedule: hog holds all 4 CPUs
+    time.sleep(0.5)
+    ray_tpu.cancel(queued)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    assert ray_tpu.get(h, timeout=60) == "hog"   # victim unaffected
+
+
+def test_cancel_running_task(ray_start_regular):
+    @ray_tpu.remote
+    def spin(path):
+        import os
+        import time as t
+
+        with open(path, "w") as f:
+            f.write("started")
+        while True:        # pure-python loop: interrupt lands promptly
+            t.sleep(0.01)
+
+    import tempfile
+
+    marker = tempfile.mktemp()
+    ref = spin.remote(marker)
+    deadline = time.time() + 60
+    import os
+
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.1)
+    assert os.path.exists(marker), "task never started"
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_force_kills_worker(ray_start_regular):
+    @ray_tpu.remote(max_retries=3)
+    def spin2(path):
+        import time as t
+
+        with open(path, "w") as f:
+            f.write("started")
+        while True:
+            t.sleep(0.01)
+
+    import os
+    import tempfile
+
+    marker = tempfile.mktemp()
+    ref = spin2.remote(marker)
+    deadline = time.time() + 60
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.1)
+    assert os.path.exists(marker)
+    ray_tpu.cancel(ref, force=True)
+    # despite max_retries=3, a force-cancelled task must NOT retry
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_cancel_finished_task_noop(ray_start_regular):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=60) == 7
+    ray_tpu.cancel(ref)            # no-op
+    assert ray_tpu.get(ref, timeout=5) == 7

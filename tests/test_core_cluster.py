@@ -304,3 +304,38 @@ def test_placement_group_task_on_remote_bundle_node(ray_start_cluster):
         return tuple(get_runtime().nodelet_addr)
 
     assert ray_tpu.get(where.remote(), timeout=60) == bundle_addr
+
+
+def test_node_affinity_targets_each_node(ray_start_cluster):
+    """NODE_AFFINITY must land the task on ITS node even when a parked
+    lease from a different node's affinity task is available for reuse
+    (regression: scheduling_class omitted the target node, so every
+    affinity task reused the first lease and ran on the driver's node —
+    which also silently faked the broadcast benchmark)."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 2.0})
+    cluster.add_node(resources={"CPU": 2.0})
+    cluster.connect()
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def who():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    import time
+
+    deadline = time.time() + 30
+    nodes = []
+    while time.time() < deadline and len(nodes) < 2:
+        nodes = [n for n in ray_tpu.nodes() if n["Alive"]]
+        time.sleep(0.2)
+    assert len(nodes) >= 2
+    # back-to-back so the previous task's parked lease is warm — the
+    # reuse path, not the fresh-lease path, is what regressed
+    for n in nodes:
+        got = ray_tpu.get(who.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=n["NodeID"])).remote(), timeout=120)
+        assert got == n["NodeID"], f"ran on {got[:8]}, wanted {n['NodeID'][:8]}"
