@@ -168,14 +168,19 @@ def bench_broadcast(nodes: int, mib: int):
         for _ in range(nodes):
             cluster.add_node(resources={"CPU": 2})
         cluster.connect()
-        nodes_info = [n for n in ray_tpu.nodes() if n["Alive"]]
+        driver_node = ray_tpu.get_runtime_context().get_node_id()
+        nodes_info = [n for n in ray_tpu.nodes()
+                      if n["Alive"] and n["NodeID"] != driver_node]
         arr = np.random.default_rng(0).integers(
             0, 255, size=mib * 1024 * 1024, dtype=np.uint8)
         ref = ray_tpu.put(arr)
 
         @ray_tpu.remote(num_cpus=0.5)
         def touch(a):
-            return int(a[0]) + len(a)
+            import ray_tpu as rtpu
+
+            return (int(a[0]) + len(a),
+                    rtpu.get_runtime_context().get_node_id())
 
         t0 = time.time()
         refs = []
@@ -185,7 +190,14 @@ def bench_broadcast(nodes: int, mib: int):
                     node_id=ni["NodeID"])).remote(ref))
         out = ray_tpu.get(refs, timeout=600)
         dt = time.time() - t0
-        assert all(o == out[0] for o in out)
+        # the measurement is only a broadcast if every pull ran on its
+        # TARGET node — a task spilled back to the owner's node reads shm
+        # locally and transfers nothing
+        ran_on = [o[1] for o in out]
+        want_on = [ni["NodeID"] for ni in nodes_info]
+        assert ran_on == want_on, \
+            f"affinity violated: ran on {ran_on} wanted {want_on}"
+        assert all(o[0] == out[0][0] for o in out)
         _emit("broadcast", mib * len(nodes_info) / dt, "MiB/s",
               mib=mib, nodes=len(nodes_info), total_s=round(dt, 1))
     finally:
@@ -203,6 +215,8 @@ def main():
     ap.add_argument("--only", choices=STAGES, default=None)
     ap.add_argument("--tasks", type=int, default=None)
     ap.add_argument("--actors", type=int, default=None)
+    ap.add_argument("--bcast-mib", type=int, default=None)
+    ap.add_argument("--bcast-nodes", type=int, default=None)
     args = ap.parse_args()
 
     scale = {
@@ -213,8 +227,8 @@ def main():
         "returns": 1_000 if args.full else 200,
         "stream": 5_000 if args.full else 500,
         "actors": args.actors or (200 if args.full else 50),
-        "bcast_nodes": 4 if args.full else 2,
-        "bcast_mib": 256 if args.full else 64,
+        "bcast_nodes": args.bcast_nodes or (4 if args.full else 2),
+        "bcast_mib": args.bcast_mib or (256 if args.full else 64),
     }
 
     import ray_tpu
@@ -229,7 +243,16 @@ def main():
             # a 200-process fork storm on one vCPU starves heartbeats;
             # widen the failure window so slowness isn't "death"
             "health_check_timeout_s": 30.0,
-            "health_check_failure_threshold": 20})
+            "health_check_failure_threshold": 20,
+            # a 1M-task submit storm monopolizes the single core for
+            # minutes: lease RPCs time out (the nodelet can't run), the
+            # lease loop's 4x-timeout deadline expires, and the WHOLE
+            # queue fails "infeasible" while every process is healthy.
+            # Deep-queue patience scales with queue depth; idle-reaping
+            # is off so executors survive the submit phase.
+            "worker_lease_timeout_s": max(
+                30.0, scale["tasks"] / 2000.0),
+            "worker_idle_timeout_s": 7200.0})
         try:
             if "queued_tasks" in stages:
                 bench_queued_tasks(scale["tasks"])
