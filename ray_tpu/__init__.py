@@ -316,8 +316,11 @@ def stack() -> Dict[str, dict]:
 
 def internal_stats() -> Dict[str, dict]:
     """Per-daemon handler counts/latency + event-loop lag
-    (ref: event_stats.h instrumentation + per-daemon OpenCensus stats)."""
-    out = {"gcs": _rt.get_runtime().gcs_call("internal_stats")}
+    (ref: event_stats.h instrumentation + per-daemon OpenCensus stats),
+    plus this process's HBM device-tier occupancy."""
+    rt = _rt.get_runtime()
+    out = {"gcs": rt.gcs_call("internal_stats"),
+           "driver": {"device_store": rt.device_store.stats()}}
     for nid, stats in _fanout_nodelets("internal_stats").items():
         out[f"nodelet:{nid[:12]}"] = stats
     return out

@@ -47,6 +47,10 @@ class _Request:
     #: sequence length = len(prompt) + len(generated) - overlap
     overlap: int = 0
     error: Optional[str] = None
+    #: prompt tokens still to be fed through the decode path after a
+    #: prefix-cache hit (the shared pages covered the tokens before
+    #: these; each decode step consumes one instead of sampling)
+    forced: List[int] = field(default_factory=list)
     done_event: threading.Event = field(default_factory=threading.Event)
     # pulsed whenever generated grows (token-streaming consumers wait on it)
     progress: threading.Event = field(default_factory=threading.Event)
@@ -62,7 +66,9 @@ class LLMEngine:
                  eos_token: int = -1, seed: int = 0, mesh=None, rules=None,
                  kv_layout: str = "contiguous", page_size: int = 64,
                  num_pages: Optional[int] = None,
-                 max_queue_depth: Optional[int] = None):
+                 max_queue_depth: Optional[int] = None,
+                 prefix_caching: bool = True,
+                 prefix_cache_max_tail: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -75,8 +81,16 @@ class LLMEngine:
             cfg = llama.PRESETS[preset]
             if jax.default_backend() != "tpu":
                 cfg = cfg.replace(dtype=jnp.float32)
-        self.cfg = cfg
         self.max_seq = max_seq_len or cfg.max_seq_len
+        if self.max_seq > cfg.max_seq_len:
+            # decode paths size their RoPE tables from cfg.max_seq_len;
+            # serving past it would CLAMP the position index (jax OOB
+            # gather) — position>=cfg.max_seq_len tokens would all get
+            # the last row's rotation, silently diverging from prefill
+            # (whose tables are sized to the actual prompt). RoPE is
+            # computed, not learned, so extending the cfg is exact.
+            cfg = cfg.replace(max_seq_len=self.max_seq)
+        self.cfg = cfg
         self.max_slots = max_slots
         self.eos = eos_token
         self.max_queue_depth = max_queue_depth
@@ -107,6 +121,17 @@ class LLMEngine:
             self.kp, self.vp = llama.init_paged_cache(cfg, num_pages,
                                                       page_size)
             self.pool = PagePool(num_pages, page_size, max_slots, maxP)
+            # automatic prefix caching (ref: vLLM APC): share full
+            # prompt pages by content hash; a hit skips that prefix's
+            # prefill compute AND its page memory. The tail cap bounds
+            # the decode-path drain a hit takes on (tail tokens feed
+            # through single-token decode — fine for the classic
+            # long-system-prompt + short-user-suffix shape; a mostly
+            # unmatched prompt takes the batched prefill instead).
+            self.prefix_caching = bool(prefix_caching)
+            self.prefix_cache_max_tail = (
+                prefix_cache_max_tail if prefix_cache_max_tail is not None
+                else 4 * page_size)
             self._len_host = np.zeros((max_slots,), np.int64)
             self._pt_dev = jnp.asarray(self.pool.table)
             self._len_dev = jnp.zeros((max_slots,), jnp.int32)
@@ -226,6 +251,7 @@ class LLMEngine:
     def _admit(self):
         import jax.numpy as jnp
 
+        cached_admits = []
         with self.lock:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if self.kv_layout == "paged":
@@ -248,6 +274,10 @@ class LLMEngine:
                         r.done_event.set()
                         r.progress.set()
                         continue
+                    if self._try_admit_cached(r, free, plen):
+                        cached_admits.append(r)
+                        self.pending.remove(r)
+                        continue
                     slot = free[0]
                     if not self.pool.grow(slot, plen):
                         break
@@ -262,6 +292,17 @@ class LLMEngine:
                 for req, slot in zip(admit, free):
                     req.slot = slot
                     self.slots[slot] = req
+        if cached_admits:
+            # prefix hits: KV for the matched pages already lives in the
+            # pool; prime the decode input with the first unprocessed
+            # prompt token — the decode loop drains the rest via
+            # r.forced. No prefill compute for these.
+            upd_slots = jnp.asarray([r.slot for r in cached_admits])
+            upd_toks = jnp.asarray(
+                [np.int32(r.forced.pop(0)) for r in cached_admits])
+            self._last = self._last.at[upd_slots, 0].set(upd_toks)
+            self._masks_dirty = True
+            self._table_dirty = True
         if not admit:
             return
         P = self._bucket(max(len(r.prompt) for r in admit))
@@ -281,6 +322,18 @@ class LLMEngine:
                 jnp.asarray(lens))
             for i, r in enumerate(admit):
                 self._len_host[r.slot] = int(lens[i])
+                if self.prefix_caching and int(lens[i]) < self.max_seq:
+                    from ray_tpu.serve.paged_kv import page_chain_hashes
+
+                    # register this prompt's FULL pages for later hits
+                    # (prefill wrote their KV; they stay read-only —
+                    # decode appends past lens[i]). Prompts truncated to
+                    # the FULL max_seq window are skipped: the lookup
+                    # side views the last max_seq-1 tokens, so the page
+                    # boundaries would shift by one token and the pages'
+                    # KV wouldn't correspond to any lookup view.
+                    self.pool.register(r.slot, page_chain_hashes(
+                        r.prompt[-int(lens[i]):], self.pool.page_size))
             self._len_dev = jnp.asarray(self._len_host.astype(np.int32))
             self._table_dirty = False
         else:
@@ -310,6 +363,50 @@ class LLMEngine:
                 self.metrics["ttft_count"] += 1
             self.metrics["tokens_generated"] += 1
             self._maybe_finish(r)
+
+    def _try_admit_cached(self, r, free: List[int], plen: int) -> bool:
+        """Prefix-cache admission (caller holds self.lock): if the
+        prompt's leading FULL pages are cached, adopt them — no prefill
+        compute, no new pages for the prefix. The unmatched tail
+        (bounded by prefix_cache_max_tail) drains through the decode
+        path via r.forced. Returns False to fall back to prefill."""
+        if not self.prefix_caching:
+            return False
+        from ray_tpu.serve.paged_kv import page_chain_hashes
+
+        ptoks = list(r.prompt[-plen:])   # view matching registration
+        # memoized: a head-of-line-blocked request would otherwise
+        # re-hash its whole prompt once per decode step until admission
+        # (preemption rebuilds the prompt and clears the memo)
+        hashes = getattr(r, "_page_hashes", None)
+        if hashes is None:
+            hashes = page_chain_hashes(ptoks, self.pool.page_size)
+            if len(hashes) * self.pool.page_size >= plen:
+                hashes = hashes[:-1]  # keep >=1 tail token as decode input
+            r._page_hashes = hashes
+        if not hashes:
+            return False
+        pages = self.pool.match_prefix(hashes)
+        if not pages:
+            return False
+        matched = len(pages) * self.pool.page_size
+        if plen - matched > self.prefix_cache_max_tail:
+            return False   # tail too long for the 1-token/step drain
+        slot = free[0]
+        self.pool.adopt(slot, pages)
+        if not self.pool.grow(slot, plen):   # room for the tail's KV
+            self.pool.release(slot)          # rollback: drops the refs
+            return False
+        free.pop(0)
+        r.slot = slot
+        self.slots[slot] = r
+        self._len_host[slot] = matched
+        r.forced = ptoks[matched:]           # first one primes _last
+        self.metrics["prefix_hits"] = \
+            self.metrics.get("prefix_hits", 0) + 1
+        self.metrics["prefix_hit_tokens"] = \
+            self.metrics.get("prefix_hit_tokens", 0) + matched
+        return True
 
     def _sample(self, logits, temps):
         import jax
@@ -367,6 +464,11 @@ class LLMEngine:
             victim.prompt = list(victim.prompt) + \
                 list(victim.generated[victim.overlap:])
             victim.overlap = len(victim.generated)
+            # a half-drained prefix tail is void: re-admission recomputes
+            # (or re-matches) the whole prompt, whose hashes also changed
+            victim.forced = []
+            if hasattr(victim, "_page_hashes"):
+                del victim._page_hashes
             self.pending.insert(0, victim)
             self._table_dirty = True
             self._masks_dirty = True
@@ -392,10 +494,12 @@ class LLMEngine:
             # precheck against the pool so a doomed attempt allocates
             # NOTHING: partial grants skew the halved retry's
             # redistribution and can force an avoidable
-            # recompute-preemption right after pages were granted
-            if pages_needed(n_try) > self.pool.free_pages:
+            # recompute-preemption right after pages were granted.
+            # available_pages counts refcount-0 cached pages too —
+            # grow() reclaims them on demand.
+            if pages_needed(n_try) > self.pool.available_pages:
                 return False
-            used_before = self.pool.used_pages
+            ver_before = self.pool.table_version
             ok = True
             for r in active:
                 if r.slot < 0:
@@ -404,8 +508,10 @@ class LLMEngine:
                 if not self.pool.grow(r.slot, min(need, self.max_seq)):
                     ok = False
                     break
-            if self.pool.used_pages != used_before:
-                # new pages entered the table: device copy is stale
+            if self.pool.table_version != ver_before:
+                # table mutated: device copy is stale. (used_pages can't
+                # detect this — growth served from cache reclaim is a
+                # net-zero page-count change.)
                 self._table_dirty = True
             return ok
 
@@ -496,16 +602,27 @@ class LLMEngine:
             for r in self.slots:
                 if r is not None:
                     temps[r.slot] = r.temperature
-        toks = np.asarray(self._sample(logits, temps))
-        self._last = jnp.asarray(toks[:, None].astype(np.int32))
+        toks = np.array(self._sample(logits, temps))  # writable: forced
+        now = time.time()                             # tokens overwrite
         for r in list(active_reqs):
             if r.slot < 0:
                 continue
+            if r.forced:
+                # prefix-cache tail drain: feed the next prompt token
+                # instead of the sample; nothing is "generated" yet
+                toks[r.slot] = r.forced.pop(0)
+                continue
             tok = int(toks[r.slot])
             r.generated.append(tok)
+            if r.first_token_time is None:
+                # cache-hit requests reach their first REAL token here
+                r.first_token_time = now
+                self.metrics["ttft_sum"] += now - r.submit_time
+                self.metrics["ttft_count"] += 1
             self.metrics["tokens_generated"] += 1
             self._maybe_finish(r)
             r.progress.set()
+        self._last = jnp.asarray(toks[:, None].astype(np.int32))
         with self.lock:
             return sum(1 for s in self.slots if s is not None)
 
@@ -526,8 +643,14 @@ class LLMEngine:
             temps = np.zeros((self.max_slots,), np.float32)
             for r in active_reqs:
                 temps[r.slot] = r.temperature
+            has_forced = any(r.forced for r in active_reqs)
         if not active_reqs:
             return 0
+        if has_forced:
+            # a prefix-cache tail is draining: the fused on-device
+            # sampler can't substitute forced tokens mid-scan — take
+            # single steps until every tail is fed
+            return self.step()
         n_eff = n
         for r in active_reqs:
             n_eff = min(n_eff,
@@ -573,11 +696,18 @@ class LLMEngine:
                 self.params, self._last, self.cache,
                 self._active_dev, self._temps_dev, self._key, n_eff)
         toks = np.asarray(toks)  # the block's single host fetch
+        now = time.time()
         for r in list(active_reqs):
             for j in range(n_eff):
                 if r.slot < 0:
                     break  # finished mid-block; surplus tokens dropped
                 r.generated.append(int(toks[j, r.slot]))
+                if r.first_token_time is None:
+                    # cache-hit requests whose forced tail drained on the
+                    # previous step land their first REAL token here
+                    r.first_token_time = now
+                    self.metrics["ttft_sum"] += now - r.submit_time
+                    self.metrics["ttft_count"] += 1
                 self.metrics["tokens_generated"] += 1
                 self._maybe_finish(r)
             r.progress.set()
@@ -619,7 +749,11 @@ class LLMServer:
                 self._wake.wait(timeout=0.01)
                 self._wake.clear()
 
-    async def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    async def __call__(self, request) -> Dict[str, Any]:
+        # handle-call payloads arrive as dicts; HTTP POSTs arrive as
+        # http_proxy.Request objects (same duality stream_request handles)
+        if not isinstance(request, dict):
+            request = request.json()
         prompt = list(request["prompt"])
         try:
             req = self.engine.submit(prompt,
@@ -689,4 +823,6 @@ class LLMServer:
         m = dict(self.engine.metrics)
         if m["ttft_count"]:
             m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
+        if getattr(self.engine, "pool", None) is not None:
+            m["prefix_cache"] = self.engine.pool.cache_stats()
         return m

@@ -7,15 +7,42 @@ physical pages and the per-slot page tables (ref: vLLM's BlockAllocator
 table is a dense [slots, max_pages] int32 the engine re-uploads only
 when membership changes).
 
+Automatic prefix caching (ref: vLLM's hash-based BlockAllocatorV2):
+pages are REFCOUNTED, and a full page of prompt tokens can be
+registered under its chain hash (hash of the page's tokens + all
+preceding pages' hash). A later prompt whose leading full pages hash
+identically ADOPTS those physical pages — the prefill compute and the
+page memory for the shared prefix are both skipped. Shared pages are
+never written: the engine only matches FULL pages and decode always
+appends past the end of the sequence. When a page's refcount drops to
+zero it parks in an LRU of evictable cached pages — still matchable —
+and is reclaimed to the free list only under pool pressure.
+
 Page 0 is reserved as the TRASH page: inactive slots and padding
 positions write there, so the allocator never hands it out.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional
 
 import numpy as np
+
+
+def page_chain_hashes(tokens, page_size: int) -> List[bytes]:
+    """Chain hash per FULL page of `tokens`: h_i = H(h_{i-1} || page_i).
+    Position-dependent by construction, so page content alone never
+    collides across different prefixes."""
+    n_full = len(tokens) // page_size
+    out, chain = [], b""
+    for i in range(n_full):
+        page = np.asarray(tokens[i * page_size:(i + 1) * page_size],
+                          np.int32).tobytes()
+        chain = hashlib.blake2b(chain + page, digest_size=16).digest()
+        out.append(chain)
+    return out
 
 
 class PagePool:
@@ -29,10 +56,27 @@ class PagePool:
         self.free: List[int] = list(range(num_pages - 1, 0, -1))
         self.table = np.zeros((max_slots, max_pages_per_slot), np.int32)
         self.owned: List[List[int]] = [[] for _ in range(max_slots)]
+        # prefix cache state
+        self.ref = np.zeros((num_pages,), np.int32)
+        self.hash_to_page: Dict[bytes, int] = {}
+        self.page_to_hash: Dict[int, bytes] = {}
+        # refcount-0 registered pages, oldest first (reclaim order)
+        self.evictable: "OrderedDict[int, None]" = OrderedDict()
+        # bumped on every table write (grow/adopt/release): the engine
+        # re-uploads the device table when this moves — inferring it
+        # from used_pages misses cache-reclaim-served growth (net 0)
+        self.table_version = 0
 
     @property
     def free_pages(self) -> int:
         return len(self.free)
+
+    @property
+    def available_pages(self) -> int:
+        """Free now plus reclaimable-from-cache (grow() reclaims on
+        demand) — capacity prechecks must use THIS, not free_pages, or
+        a warm cache would make the pool look artificially full."""
+        return len(self.free) + len(self.evictable)
 
     @property
     def used_pages(self) -> int:
@@ -42,7 +86,22 @@ class PagePool:
         return -(-tokens // self.page_size)
 
     def can_fit(self, tokens: int) -> bool:
-        return self.pages_for(tokens) <= len(self.free)
+        return self.pages_for(tokens) <= self.available_pages
+
+    def _unregister(self, page: int) -> None:
+        h = self.page_to_hash.pop(page, None)
+        if h is not None and self.hash_to_page.get(h) == page:
+            del self.hash_to_page[h]
+
+    def _reclaim(self, n: int) -> int:
+        """Evict up to n refcount-0 cached pages (LRU) to the free list."""
+        got = 0
+        while got < n and self.evictable:
+            page, _ = self.evictable.popitem(last=False)
+            self._unregister(page)
+            self.free.append(page)
+            got += 1
+        return got
 
     def grow(self, slot: int, total_tokens: int) -> bool:
         """Ensure `slot` owns enough pages for total_tokens. Returns
@@ -54,14 +113,71 @@ class PagePool:
         if extra <= 0:
             return True
         if extra > len(self.free):
+            self._reclaim(extra - len(self.free))
+        if extra > len(self.free):
             return False
         for _ in range(extra):
             p = self.free.pop()
             self.table[slot, len(self.owned[slot])] = p
             self.owned[slot].append(p)
+            self.ref[p] = 1
+        self.table_version += 1
         return True
 
     def release(self, slot: int) -> None:
-        self.free.extend(reversed(self.owned[slot]))
+        for p in reversed(self.owned[slot]):
+            self.ref[p] -= 1
+            if self.ref[p] <= 0:
+                self.ref[p] = 0
+                if p in self.page_to_hash:
+                    # cached: park, still matchable until reclaimed
+                    self.evictable[p] = None
+                else:
+                    self.free.append(p)
         self.owned[slot] = []
         self.table[slot] = 0
+        self.table_version += 1
+
+    # ---- prefix cache ------------------------------------------------------
+
+    def match_prefix(self, hashes: List[bytes]) -> List[int]:
+        """Longest run of leading hashes present in the cache; returns
+        their physical pages (does NOT take references — adopt() does)."""
+        pages = []
+        for h in hashes:
+            p = self.hash_to_page.get(h)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def adopt(self, slot: int, pages: List[int]) -> None:
+        """Append shared pages to a slot's table, taking a reference on
+        each. Caller guarantees the slot's table is empty (fresh admit)."""
+        for p in pages:
+            self.table[slot, len(self.owned[slot])] = p
+            self.owned[slot].append(p)
+            self.ref[p] += 1
+            self.evictable.pop(p, None)     # in use again
+        self.table_version += 1
+        if len(self.owned[slot]) > self.max_pages_per_slot:
+            raise ValueError("adopted prefix exceeds max_pages_per_slot")
+
+    def register(self, slot: int, hashes: List[bytes]) -> None:
+        """Register the slot's first len(hashes) pages under their chain
+        hashes (post-prefill). First writer wins: an existing mapping for
+        a hash is kept — duplicates converge on the earlier page as later
+        prompts adopt it."""
+        for i, h in enumerate(hashes):
+            if i >= len(self.owned[slot]):
+                break
+            p = self.owned[slot][i]
+            if h in self.hash_to_page or p in self.page_to_hash:
+                continue
+            self.hash_to_page[h] = p
+            self.page_to_hash[p] = h
+
+    def cache_stats(self) -> dict:
+        return {"registered": len(self.hash_to_page),
+                "evictable": len(self.evictable),
+                "free": len(self.free)}

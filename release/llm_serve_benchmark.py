@@ -55,6 +55,11 @@ def main():
     ap.add_argument("--num-pages", type=int, default=None)
     ap.add_argument("--max-slots", type=int, default=None)
     ap.add_argument("--max-queue-depth", type=int, default=None)
+    ap.add_argument("--prefix-caching", choices=["on", "off"],
+                    default="on",
+                    help="paged-only: every request here shares one "
+                    "prompt, so 'on' measures the warm prefix-hit path "
+                    "(recorded in the output for comparability)")
     args = ap.parse_args()
 
     from ray_tpu.serve.llm import LLMQueueFull, LLMServer
@@ -64,7 +69,8 @@ def main():
     kw = {"max_queue_depth": args.max_queue_depth}
     if args.kv_layout == "paged":
         kw.update(kv_layout="paged", page_size=args.page_size,
-                  num_pages=args.num_pages)
+                  num_pages=args.num_pages,
+                  prefix_caching=args.prefix_caching == "on")
     server = LLMServer(preset=args.preset, max_slots=max_slots,
                        decode_block=args.decode_block, **kw)
     rtt = measure_tunnel_rtt()
@@ -163,6 +169,8 @@ def main():
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
         "engine_prefill_ms": round(engine_prefill_s * 1e3, 1),
         "kv_layout": args.kv_layout,
+        "prefix_caching": (args.prefix_caching == "on"
+                           if args.kv_layout == "paged" else None),
         "max_slots": max_slots,
         "rejected_429": rejected[0],
         "stats": server.stats(),

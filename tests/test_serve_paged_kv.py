@@ -72,11 +72,19 @@ def test_paged_matches_contiguous():
 def test_paged_page_reuse_and_release():
     eng = LLMEngine(preset="tiny", max_slots=2, max_seq_len=32, seed=0,
                     kv_layout="paged", page_size=8, num_pages=9)
-    assert eng.pool.free_pages == 8
+    assert eng.pool.available_pages == 8
     _greedy(eng, [1, 2, 3, 4], 8)
-    assert eng.pool.free_pages == 8          # released on finish
+    # released on finish: every page is reusable again — registered
+    # prefix pages park in the evictable cache, the rest go free
+    assert eng.pool.available_pages == 8
     _greedy(eng, [5] * 10, 8)
-    assert eng.pool.free_pages == 8
+    assert eng.pool.available_pages == 8
+    # and with caching off, release goes straight back to the free list
+    eng2 = LLMEngine(preset="tiny", max_slots=2, max_seq_len=32, seed=0,
+                     kv_layout="paged", page_size=8, num_pages=9,
+                     prefix_caching=False)
+    _greedy(eng2, [1, 2, 3, 4], 8)
+    assert eng2.pool.free_pages == 8
 
 
 def test_paged_concurrency_beyond_contiguous_hbm():
@@ -162,3 +170,93 @@ def test_oversized_prompt_rejected_not_stuck():
     assert big.done_event.is_set()
     assert big.error and "exceeds" in big.error
     assert len(ok.generated) == 4 and ok.error is None
+
+
+def test_prefix_cache_hit_matches_cold():
+    """Automatic prefix caching (ref: vLLM APC): a second prompt sharing
+    the first's full pages must adopt them (no prefill, shared physical
+    pages) and still emit the exact same greedy continuation."""
+    shared = list(range(1, 25))                     # 3 full pages @ ps=8
+    tail_a, tail_b = [30, 31], [30, 31]             # identical requests
+    eng = LLMEngine(preset="tiny", max_slots=4, max_seq_len=64, seed=5,
+                    kv_layout="paged", page_size=8)
+    cold = _greedy(eng, shared + tail_a, 10)
+    assert eng.metrics.get("prefix_hits", 0) == 0
+    used_before = eng.pool.used_pages
+    warm = _greedy(eng, shared + tail_b, 10)
+    assert eng.metrics.get("prefix_hits", 0) == 1
+    assert eng.metrics.get("prefix_hit_tokens", 0) == 24
+    assert warm == cold, (cold, warm)
+    # the hit must SHARE the 3 prefix pages, not copy them: only the
+    # tail + generation may allocate beyond the snapshot (prompt 26 +
+    # 10 generated = 36 tokens -> 5 pages; 3 shared -> at most 2 new)
+    assert eng.pool.used_pages - used_before <= 2, \
+        (used_before, eng.pool.used_pages)
+    # reference engine without caching agrees too
+    ref = LLMEngine(preset="tiny", max_slots=4, max_seq_len=64, seed=5,
+                    kv_layout="paged", page_size=8, prefix_caching=False)
+    assert _greedy(ref, shared + tail_b, 10) == cold
+
+
+def test_prefix_cache_divergent_tail():
+    """Same prefix, different tails: both hit the cache yet produce
+    their own (distinct, correct) continuations."""
+    shared = [3] * 16                               # 2 full pages @ ps=8
+    eng = LLMEngine(preset="tiny", max_slots=4, max_seq_len=64, seed=6,
+                    kv_layout="paged", page_size=8)
+    ref = LLMEngine(preset="tiny", max_slots=4, max_seq_len=64, seed=6,
+                    kv_layout="paged", page_size=8, prefix_caching=False)
+    a = _greedy(eng, shared + [40, 41], 8)
+    b = _greedy(eng, shared + [50, 51, 52], 8)
+    assert eng.metrics.get("prefix_hits", 0) == 1   # second request hit
+    assert a == _greedy(ref, shared + [40, 41], 8)
+    assert b == _greedy(ref, shared + [50, 51, 52], 8)
+
+
+def test_prefix_cache_pages_shared_not_copied():
+    """Concurrent requests with one cached prefix consume pages for the
+    prefix ONCE (refcounted sharing, not copies)."""
+    shared = list(range(2, 26))                     # 3 full pages @ ps=8
+    eng = LLMEngine(preset="tiny", max_slots=4, max_seq_len=64, seed=7,
+                    kv_layout="paged", page_size=8)
+    eng.generate(shared + [40], 2)                  # registers the prefix
+    r1 = eng.submit(shared + [41], 4)
+    r2 = eng.submit(shared + [42], 4)
+    eng._admit()
+    with eng.lock:
+        o1, o2 = eng.pool.owned[r1.slot], eng.pool.owned[r2.slot]
+    assert o1[:3] == o2[:3], "prefix pages must be the same physical pages"
+    assert (eng.pool.ref[o1[0]] >= 2), "shared page must be multi-ref"
+    while not (r1.done_event.is_set() and r2.done_event.is_set()):
+        eng.step()
+    assert r1.error is None and r2.error is None
+
+
+def test_prefix_cache_eviction_under_pressure():
+    """Refcount-0 cached pages are reclaimable: filling the pool with
+    new requests evicts them instead of failing admission."""
+    eng = LLMEngine(preset="tiny", max_slots=2, max_seq_len=32, seed=8,
+                    kv_layout="paged", page_size=8, num_pages=9)
+    eng.generate(list(range(1, 18)), 3)             # registers 2 pages
+    assert eng.pool.cache_stats()["registered"] >= 1
+    assert len(eng.pool.evictable) >= 1
+    # a fat unrelated prompt needs more pages than the free list alone
+    out = eng.generate([9] * 20, 3)
+    assert len(out) == 3
+    assert eng.pool.used_pages <= eng.pool.num_pages - 1
+
+
+def test_decode_beyond_preset_max_seq_rope():
+    """Serving past the preset's cfg.max_seq_len must extend the RoPE
+    tables (regression: decode paths sized tables from cfg.max_seq_len,
+    and jax's clamping OOB gather gave every position >= that the LAST
+    row's rotation — silently diverging from prefill, which sizes its
+    tables to the actual prompt). tiny preset: cfg.max_seq_len=128."""
+    long_prompt = list(range(2, 160))     # crosses 128 during decode
+    eng = LLMEngine(preset="tiny", max_slots=2, max_seq_len=256, seed=9,
+                    kv_layout="paged", page_size=64)
+    assert eng.cfg.max_seq_len == 256     # extended by the engine
+    out_paged = _greedy(eng, long_prompt, 6)
+    cont = LLMEngine(preset="tiny", max_slots=2, max_seq_len=256, seed=9)
+    out_cont = _greedy(cont, long_prompt, 6)
+    assert out_paged == out_cont, (out_paged, out_cont)
