@@ -656,6 +656,102 @@ def decode_step_paged(params, tokens, k_pools, v_pools, page_table,
     return logits, nk, nv, lengths + active
 
 
+def prefill_paged_tail(params, tokens, tail_len, prefix_len, page_table,
+                       k_pools, v_pools, cfg: LlamaConfig
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Chunked prefill of a prompt TAIL against existing paged prefix KV
+    (the compute half of automatic prefix caching — ref: vLLM's chunked
+    prefill with prefix blocks). tokens [B, T] right-padded tail tokens;
+    tail_len [B] true tail lengths; prefix_len [B] tokens already in the
+    pages; page_table [B, maxP]. Writes the tail's KV into the pages and
+    returns (logits at each row's final tail token [B, V], k_pools,
+    v_pools). Cost O(T * (prefix+T)) instead of the full O((prefix+T)^2)
+    re-prefill — and ONE device call instead of T decode steps (which on
+    a remote-attach transport cost a round trip each)."""
+    dt = cfg.dtype
+    B, T = tokens.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ps = k_pools.shape[3]
+    maxP = page_table.shape[1]
+    S_view = maxP * ps
+    grp = H // KV
+
+    # absolute positions of the tail tokens, per row
+    qpos = prefix_len[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    valid = (jnp.arange(T)[None, :] < tail_len[:, None])         # [B, T]
+    cos_full, sin_full = _rope_tables(cfg.rope_theta, cfg.max_seq_len,
+                                      cfg.head_dim)
+    safe_pos = jnp.minimum(qpos, cfg.max_seq_len - 1)
+    cos = cos_full[safe_pos]                                     # [B, T, HD/2]
+    sin = sin_full[safe_pos]
+
+    def rope(x):   # [B, T, N, HD]
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                               axis=-1).astype(x.dtype)
+
+    # physical write targets; padded rows land in trash page 0
+    page_ids = jnp.take_along_axis(page_table, qpos // ps, axis=1)  # [B, T]
+    page_ids = jnp.where(valid, page_ids, 0)
+    offsets = qpos % ps
+    pid_f = page_ids.reshape(-1)
+    off_f = offsets.reshape(-1)
+
+    # attention mask over the gathered page view [B, S_view]: causal
+    # against absolute key position, bounded by each row's total length
+    kv_pos = jnp.arange(S_view)[None, :]                         # [1, S_view]
+    total = (prefix_len + tail_len)[:, None]
+    base_mask = kv_pos < total                                   # [B, S_view]
+    causal = kv_pos[:, None, :] <= qpos[:, :, None]              # [B, T, S_view]
+    mask = base_mask[:, None, :] & causal                        # [B, T, S_view]
+
+    x = params["embed"].astype(dt)[tokens]                       # [B, T, D]
+
+    def body(x, inp):
+        lp, kp, vp = inp                              # kp [KV, NP, ps, HD]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = rope((h @ lp["wq"].astype(dt)).reshape(B, T, H, HD))
+        k = rope((h @ lp["wk"].astype(dt)).reshape(B, T, KV, HD))
+        v = (h @ lp["wv"].astype(dt)).reshape(B, T, KV, HD)
+        # write tail KV FIRST: the gathered view then covers prefix+tail
+        # and one causal mask handles both
+        k_f = k.reshape(B * T, KV, HD).transpose(1, 0, 2)
+        v_f = v.reshape(B * T, KV, HD).transpose(1, 0, 2)
+        kp = kp.at[:, pid_f, off_f, :].set(k_f.astype(kp.dtype))
+        vp = vp.at[:, pid_f, off_f, :].set(v_f.astype(vp.dtype))
+        # gather each row's pages into a contiguous [S_view] key space
+        kg = jnp.take(kp, page_table, axis=1)         # [KV, B, maxP, ps, HD]
+        vg = jnp.take(vp, page_table, axis=1)
+        kg = kg.transpose(1, 0, 2, 3, 4).reshape(B, KV, S_view, HD)
+        vg = vg.transpose(1, 0, 2, 3, 4).reshape(B, KV, S_view, HD)
+        kg = jnp.repeat(kg, grp, axis=1)              # GQA -> [B, H, S, HD]
+        vg = jnp.repeat(vg, grp, axis=1)
+        qh = q.transpose(0, 2, 1, 3)                  # [B, H, T, HD]
+        scores = jnp.einsum("bhtd,bhsd->bhts", qh.astype(jnp.float32),
+                            kg.astype(jnp.float32)) / (HD ** 0.5)
+        scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhts,bhsd->bhtd", probs,
+                       vg.astype(jnp.float32)).astype(dt)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, H * HD)
+        x = x + o @ lp["wo"].astype(dt)
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (kp, vp)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], k_pools,
+                                         v_pools))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    idx = jnp.clip(tail_len - 1, 0, T - 1)
+    last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return logits, nk, nv
+
+
 def scatter_prefill_pages(k_pools, v_pools, ks, vs, page_table, slots,
                           lengths, page_size: int):
     """Write prefill k/v into the pools. ks/vs [L, n, P, KV, HD] (from

@@ -47,10 +47,6 @@ class _Request:
     #: sequence length = len(prompt) + len(generated) - overlap
     overlap: int = 0
     error: Optional[str] = None
-    #: prompt tokens still to be fed through the decode path after a
-    #: prefix-cache hit (the shared pages covered the tokens before
-    #: these; each decode step consumes one instead of sampling)
-    forced: List[int] = field(default_factory=list)
     done_event: threading.Event = field(default_factory=threading.Event)
     # pulsed whenever generated grows (token-streaming consumers wait on it)
     progress: threading.Event = field(default_factory=threading.Event)
@@ -123,11 +119,11 @@ class LLMEngine:
             self.pool = PagePool(num_pages, page_size, max_slots, maxP)
             # automatic prefix caching (ref: vLLM APC): share full
             # prompt pages by content hash; a hit skips that prefix's
-            # prefill compute AND its page memory. The tail cap bounds
-            # the decode-path drain a hit takes on (tail tokens feed
-            # through single-token decode — fine for the classic
-            # long-system-prompt + short-user-suffix shape; a mostly
-            # unmatched prompt takes the batched prefill instead).
+            # prefill compute AND its page memory, and ONE chunked
+            # tail-prefill call (O(T x total) attention against the
+            # cached pages) finishes admission. The tail cap bounds
+            # that call's cost; a mostly-unmatched prompt takes the
+            # plain batched prefill instead.
             self.prefix_caching = bool(prefix_caching)
             self.prefix_cache_max_tail = (
                 prefix_cache_max_tail if prefix_cache_max_tail is not None
@@ -163,6 +159,13 @@ class LLMEngine:
                 scatter_prefill_pages(kp, vp, ks, vs, pt, sl, ln,
                                       page_size),
                 donate_argnums=(0, 1))
+            # chunked tail prefill against cached prefix pages: ONE
+            # device call finishes a prefix-hit admission (token-by-token
+            # draining costs a transport round trip per tail token)
+            self._prefill_tail = jax.jit(
+                lambda p, t, tl, pl, pt, kp, vp: llama.prefill_paged_tail(
+                    p, t, tl, pl, pt, kp, vp, cfg),
+                donate_argnums=(5, 6))
 
             def _multi_paged(params, last, kp, vp, pt, ln, active, temps,
                              key, n):
@@ -294,15 +297,52 @@ class LLMEngine:
                     self.slots[slot] = req
         if cached_admits:
             # prefix hits: KV for the matched pages already lives in the
-            # pool; prime the decode input with the first unprocessed
-            # prompt token — the decode loop drains the rest via
-            # r.forced. No prefill compute for these.
+            # pool. ONE chunked tail-prefill call computes the unmatched
+            # tail against it (O(T * total) attention) and yields each
+            # row's first-token logits — no full re-prefill, no
+            # per-token decode draining.
+            Tb = self._bucket(max(len(r._tail) for r in cached_admits))
+            n = len(cached_admits)
+            # pad the BATCH dim to a pow2 bucket too: every distinct
+            # (n, T) shape is its own XLA program, and admission batch
+            # sizes vary request-to-request. Pad rows have tail_len 0,
+            # so their writes land in the trash page.
+            nb = 1
+            while nb < n:
+                nb *= 2
+            toks_t = np.zeros((nb, Tb), np.int32)
+            tl = np.zeros((nb,), np.int32)
+            pl = np.zeros((nb,), np.int32)
+            for i, r in enumerate(cached_admits):
+                toks_t[i, :len(r._tail)] = r._tail
+                tl[i] = len(r._tail)
+                pl[i] = r._prefix_matched
+            rows = np.zeros((nb, self.pool.table.shape[1]), np.int32)
+            rows[:n] = self.pool.table[[r.slot for r in cached_admits]]
+            tables = jnp.asarray(rows)
+            logits_t, self.kp, self.vp = self._prefill_tail(
+                self.params, jnp.asarray(toks_t), jnp.asarray(tl),
+                jnp.asarray(pl), tables, self.kp, self.vp)
+            for i, r in enumerate(cached_admits):
+                self._len_host[r.slot] = int(pl[i]) + int(tl[i])
             upd_slots = jnp.asarray([r.slot for r in cached_admits])
-            upd_toks = jnp.asarray(
-                [np.int32(r.forced.pop(0)) for r in cached_admits])
-            self._last = self._last.at[upd_slots, 0].set(upd_toks)
+            temps_t = [r.temperature for r in cached_admits] + \
+                [0.0] * (nb - n)
+            first_t = np.asarray(self._sample(logits_t, temps_t))[:n]
+            self._last = self._last.at[upd_slots, 0].set(
+                jnp.asarray(first_t.astype(np.int32)))
             self._masks_dirty = True
             self._table_dirty = True
+            now = time.time()
+            for i, r in enumerate(cached_admits):
+                tok = int(first_t[i])
+                r.generated.append(tok)
+                if r.first_token_time is None:
+                    r.first_token_time = now
+                    self.metrics["ttft_sum"] += now - r.submit_time
+                    self.metrics["ttft_count"] += 1
+                self.metrics["tokens_generated"] += 1
+                self._maybe_finish(r)
         if not admit:
             return
         P = self._bucket(max(len(r.prompt) for r in admit))
@@ -368,8 +408,9 @@ class LLMEngine:
         """Prefix-cache admission (caller holds self.lock): if the
         prompt's leading FULL pages are cached, adopt them — no prefill
         compute, no new pages for the prefix. The unmatched tail
-        (bounded by prefix_cache_max_tail) drains through the decode
-        path via r.forced. Returns False to fall back to prefill."""
+        (bounded by prefix_cache_max_tail) is finished by ONE chunked
+        tail-prefill call in _admit. Returns False to fall back to the
+        full prefill."""
         if not self.prefix_caching:
             return False
         from ray_tpu.serve.paged_kv import page_chain_hashes
@@ -391,7 +432,7 @@ class LLMEngine:
             return False
         matched = len(pages) * self.pool.page_size
         if plen - matched > self.prefix_cache_max_tail:
-            return False   # tail too long for the 1-token/step drain
+            return False   # tail too big for the bucketed tail-prefill
         slot = free[0]
         self.pool.adopt(slot, pages)
         if not self.pool.grow(slot, plen):   # room for the tail's KV
@@ -400,8 +441,9 @@ class LLMEngine:
         free.pop(0)
         r.slot = slot
         self.slots[slot] = r
-        self._len_host[slot] = matched
-        r.forced = ptoks[matched:]           # first one primes _last
+        self._len_host[slot] = matched       # tail-prefill advances it
+        r._tail = ptoks[matched:]
+        r._prefix_matched = matched
         self.metrics["prefix_hits"] = \
             self.metrics.get("prefix_hits", 0) + 1
         self.metrics["prefix_hit_tokens"] = \
@@ -464,9 +506,7 @@ class LLMEngine:
             victim.prompt = list(victim.prompt) + \
                 list(victim.generated[victim.overlap:])
             victim.overlap = len(victim.generated)
-            # a half-drained prefix tail is void: re-admission recomputes
-            # (or re-matches) the whole prompt, whose hashes also changed
-            victim.forced = []
+            # the resume prompt changed, so its page hashes did too
             if hasattr(victim, "_page_hashes"):
                 del victim._page_hashes
             self.pending.insert(0, victim)
@@ -602,27 +642,21 @@ class LLMEngine:
             for r in self.slots:
                 if r is not None:
                     temps[r.slot] = r.temperature
-        toks = np.array(self._sample(logits, temps))  # writable: forced
-        now = time.time()                             # tokens overwrite
+        toks = np.asarray(self._sample(logits, temps))
+        self._last = jnp.asarray(toks[:, None].astype(np.int32))
+        now = time.time()
         for r in list(active_reqs):
             if r.slot < 0:
-                continue
-            if r.forced:
-                # prefix-cache tail drain: feed the next prompt token
-                # instead of the sample; nothing is "generated" yet
-                toks[r.slot] = r.forced.pop(0)
                 continue
             tok = int(toks[r.slot])
             r.generated.append(tok)
             if r.first_token_time is None:
-                # cache-hit requests reach their first REAL token here
                 r.first_token_time = now
                 self.metrics["ttft_sum"] += now - r.submit_time
                 self.metrics["ttft_count"] += 1
             self.metrics["tokens_generated"] += 1
             self._maybe_finish(r)
             r.progress.set()
-        self._last = jnp.asarray(toks[:, None].astype(np.int32))
         with self.lock:
             return sum(1 for s in self.slots if s is not None)
 
@@ -643,14 +677,8 @@ class LLMEngine:
             temps = np.zeros((self.max_slots,), np.float32)
             for r in active_reqs:
                 temps[r.slot] = r.temperature
-            has_forced = any(r.forced for r in active_reqs)
         if not active_reqs:
             return 0
-        if has_forced:
-            # a prefix-cache tail is draining: the fused on-device
-            # sampler can't substitute forced tokens mid-scan — take
-            # single steps until every tail is fed
-            return self.step()
         n_eff = n
         for r in active_reqs:
             n_eff = min(n_eff,
@@ -702,10 +730,8 @@ class LLMEngine:
                 if r.slot < 0:
                     break  # finished mid-block; surplus tokens dropped
                 r.generated.append(int(toks[j, r.slot]))
-                if r.first_token_time is None:
-                    # cache-hit requests whose forced tail drained on the
-                    # previous step land their first REAL token here
-                    r.first_token_time = now
+                if r.first_token_time is None:   # defensive: admission
+                    r.first_token_time = now     # normally records TTFT
                     self.metrics["ttft_sum"] += now - r.submit_time
                     self.metrics["ttft_count"] += 1
                 self.metrics["tokens_generated"] += 1
