@@ -12,12 +12,16 @@ Run:  PYTHONPATH=/root/repo python release/device_tier_benchmark.py
 """
 
 import json
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import ray_tpu
+import jax                      # noqa: E402
+import jax.numpy as jnp         # noqa: E402
+
+import ray_tpu                  # noqa: E402
 
 SIZES_MIB = [1, 16, 64, 256]
 REPS = 5
