@@ -32,10 +32,25 @@ def test_cancel_queued_task(ray_start_regular):
     assert ray_tpu.get(h, timeout=60) == "hog"   # victim unaffected
 
 
+
+
+def _start_and_wait(make_ref, timeout=60.0):
+    """Submit a spin task via make_ref(marker_path) and block until its
+    marker file appears (the task is verifiably executing)."""
+    import os
+    import tempfile
+
+    marker = tempfile.mktemp()
+    ref = make_ref(marker)
+    deadline = time.time() + timeout
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.1)
+    assert os.path.exists(marker), "task never started"
+    return ref
+
 def test_cancel_running_task(ray_start_regular):
     @ray_tpu.remote
     def spin(path):
-        import os
         import time as t
 
         with open(path, "w") as f:
@@ -43,16 +58,7 @@ def test_cancel_running_task(ray_start_regular):
         while True:        # pure-python loop: interrupt lands promptly
             t.sleep(0.01)
 
-    import tempfile
-
-    marker = tempfile.mktemp()
-    ref = spin.remote(marker)
-    deadline = time.time() + 60
-    import os
-
-    while time.time() < deadline and not os.path.exists(marker):
-        time.sleep(0.1)
-    assert os.path.exists(marker), "task never started"
+    ref = _start_and_wait(spin.remote)
     ray_tpu.cancel(ref)
     with pytest.raises(TaskCancelledError):
         ray_tpu.get(ref, timeout=60)
@@ -68,15 +74,7 @@ def test_cancel_force_kills_worker(ray_start_regular):
         while True:
             t.sleep(0.01)
 
-    import os
-    import tempfile
-
-    marker = tempfile.mktemp()
-    ref = spin2.remote(marker)
-    deadline = time.time() + 60
-    while time.time() < deadline and not os.path.exists(marker):
-        time.sleep(0.1)
-    assert os.path.exists(marker)
+    ref = _start_and_wait(spin2.remote)
     ray_tpu.cancel(ref, force=True)
     # despite max_retries=3, a force-cancelled task must NOT retry
     with pytest.raises(TaskCancelledError):
@@ -92,3 +90,39 @@ def test_cancel_finished_task_noop(ray_start_regular):
     assert ray_tpu.get(ref, timeout=60) == 7
     ray_tpu.cancel(ref)            # no-op
     assert ray_tpu.get(ref, timeout=5) == 7
+
+
+def test_cancel_running_actor_method(ray_start_regular):
+    """Actor-call refs route the interrupt to the actor's worker; the
+    actor SURVIVES (only the method's thread is interrupted) and serves
+    subsequent calls."""
+    @ray_tpu.remote
+    class Worker:
+        def spin(self, path):
+            with open(path, "w") as f:
+                f.write("started")
+            while True:
+                time.sleep(0.01)
+
+        def ping(self):
+            return "pong"
+
+    a = Worker.remote()
+    ref = _start_and_wait(a.spin.remote)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    # the actor itself lives on
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(a)
+
+
+def test_cancel_recursive_unimplemented(ray_start_regular):
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    ref = quick.remote()
+    with pytest.raises(NotImplementedError):
+        ray_tpu.cancel(ref, recursive=True)
+    assert ray_tpu.get(ref, timeout=30) == 1
