@@ -105,3 +105,44 @@ def test_spill_stats_surface(small_store_cluster):
     assert spilled > 0
     assert stats.get("spilled_bytes", 0) > 0
     del refs
+
+
+def test_device_tier_full_spill_chain():
+    """The complete HBM -> shm -> disk -> get chain (SURVEY §7 step 2):
+    device puts over the HBM watermark demote LRU objects into a tiny
+    shm store, whose own watermark spills them to disk; gets restore
+    every value intact (as host arrays — demotion is one-way)."""
+    import jax.numpy as jnp
+
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "object_store_memory": 16 * 1024 * 1024,
+            "object_spill_threshold": 0.7,
+            "object_spill_low_water": 0.4,
+            # device tier holds ~2 x 2 MiB objects before demoting
+            "device_object_store_bytes": 5 * 1024 * 1024,
+        },
+    )
+    try:
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        refs = [ray_tpu.put(jnp.full((2 * 1024 * 1024 // 4,),
+                                     float(i), jnp.float32))
+                for i in range(12)]   # 24 MiB through a 5 MiB HBM budget
+        assert rt.device_store.stats()["bytes"] \
+            <= rt.device_store.capacity
+        # the early objects were demoted out of the device tier; pushing
+        # 24 MiB through the 16 MiB shm store forced disk spills too
+        assert not rt.device_store.contains(refs[0].id)
+        i = 0
+        while refs:
+            out = ray_tpu.get(refs.pop(0))
+            assert float(np.asarray(out)[0]) == float(i)
+            assert float(np.asarray(out)[-1]) == float(i)
+            del out
+            i += 1
+        assert i == 12
+    finally:
+        ray_tpu.shutdown()
