@@ -23,10 +23,7 @@ from collections import OrderedDict
 from typing import Any, List, Optional, Tuple
 
 
-def is_device_value(x: Any) -> bool:
-    """True for a jax.Array we can keep device-resident: a concrete,
-    fully-addressable array (a traced or multi-host-sharded value has no
-    locally-ownable buffers)."""
+def _is_device_array(x: Any) -> bool:
     t = type(x)
     if not (t.__module__.startswith("jax")
             and t.__name__ in ("ArrayImpl", "Array")):
@@ -35,6 +32,56 @@ def is_device_value(x: Any) -> bool:
         return bool(x.is_fully_addressable) and not x.is_deleted()
     except Exception:
         return False
+
+
+def is_device_value(x: Any) -> bool:
+    """True for a value the HBM tier accepts: a concrete,
+    fully-addressable jax.Array, or a pytree whose EVERY leaf is one
+    (the train/serve hot-path shape — a params pytree put for weight
+    sync). Mixed trees take the host path: partial residency would
+    split one object across tiers."""
+    return try_device_snapshot(x, -1) is not None
+
+
+def try_device_snapshot(x: Any, min_bytes: int):
+    """ONE traversal deciding device-tier admission: returns
+    (snapshot, nbytes) or None. The snapshot shares every leaf buffer
+    (zero-copy) but owns fresh containers, so the caller mutating its
+    own dict/list after put() cannot desync the stored object or its
+    byte accounting. nbytes dedupes aliased leaves (tied weights appear
+    once per buffer, not once per tree path)."""
+    if _is_device_array(x):
+        n = int(x.nbytes)
+        return (x, n) if n > min_bytes else None
+    if not isinstance(x, (dict, list, tuple)) or not x:
+        return None
+    try:
+        import jax
+
+        leaves, treedef = jax.tree.flatten(x)
+    except Exception:
+        return None
+    if not leaves or not all(_is_device_array(a) for a in leaves):
+        return None
+    seen, total = set(), 0
+    for a in leaves:
+        if id(a) not in seen:
+            seen.add(id(a))
+            total += int(a.nbytes)
+    if total <= min_bytes:
+        return None
+    return jax.tree.unflatten(treedef, leaves), total
+
+
+def any_leaf_deleted(x: Any) -> bool:
+    """True if any array in the value was donated/deleted under us."""
+    import jax
+
+    leaves = [x] if _is_device_array(x) else jax.tree.leaves(x)
+    for a in leaves:
+        if getattr(a, "is_deleted", lambda: False)():
+            return True
+    return False
 
 
 class DeviceStore:
@@ -51,8 +98,10 @@ class DeviceStore:
         self._objs: "OrderedDict[Any, Tuple[Any, int]]" = OrderedDict()
         self.total = 0
 
-    def put(self, oid, arr) -> int:
-        nbytes = int(arr.nbytes)
+    def put(self, oid, arr, nbytes: Optional[int] = None) -> int:
+        if nbytes is None:
+            snap = try_device_snapshot(arr, -1)
+            nbytes = snap[1] if snap else 0
         with self._lock:
             old = self._objs.pop(oid, None)
             if old is not None:

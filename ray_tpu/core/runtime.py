@@ -448,19 +448,22 @@ class Runtime:
         write); same-process get returns the identical jax.Array, and
         _stage_device_object demotes it to shm only when a remote
         consumer or HBM pressure demands host bytes."""
-        from ray_tpu.core.device_store import is_device_value
+        from ray_tpu.core.device_store import try_device_snapshot
 
-        if (self.cfg.device_object_tier and is_device_value(value)
-                and value.nbytes > self.cfg.max_direct_call_object_size):
-            oid = self._next_put_id()
-            e = self._entry(oid)
-            self.refs.register_owned(oid)
-            e.size = self.device_store.put(oid, value)
-            self.memory_store.put(oid, value)
-            e.state = "ready"
-            self._complete_entry(e)
-            self._enforce_device_capacity()
-            return ObjectRef(oid, self.address)
+        if self.cfg.device_object_tier:
+            snap = try_device_snapshot(
+                value, self.cfg.max_direct_call_object_size)
+            if snap is not None:
+                value, nbytes = snap   # fresh containers, shared buffers
+                oid = self._next_put_id()
+                e = self._entry(oid)
+                self.refs.register_owned(oid)
+                e.size = self.device_store.put(oid, value, nbytes)
+                self.memory_store.put(oid, value)
+                e.state = "ready"
+                self._complete_entry(e)
+                self._enforce_device_capacity()
+                return ObjectRef(oid, self.address)
         oid = self._next_put_id()
         meta, bufs = serialization.serialize(value)
         size = serialization.serialized_size(meta, bufs)
@@ -540,8 +543,10 @@ class Runtime:
             arr = self.device_store.get(oid)
             if arr is None:
                 return self.store.contains(oid)
+            from ray_tpu.core.device_store import any_leaf_deleted
+
             e = self._entry(oid)
-            if getattr(arr, "is_deleted", lambda: False)():
+            if any_leaf_deleted(arr):
                 # the user donated the live buffer without take(): the
                 # bytes are unrecoverable. Mark lost (an explicit error
                 # on get) instead of letting the deleted-array raise
