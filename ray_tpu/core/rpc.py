@@ -68,6 +68,8 @@ class RpcServer:
         self._loop_lag_s = 0.0
         self._loop_lag_max_s = 0.0
         self._lag_task: Optional[asyncio.Task] = None
+        self._conns: set = set()          # live connection writers
+        self._dispatches: set = set()     # in-flight handler tasks
 
     async def start(self) -> Tuple[str, int]:
         self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
@@ -116,21 +118,49 @@ class RpcServer:
             self._lag_task = None
         if self._server:
             self._server.close()
+        # Grace first, with writers still open, so in-flight handlers can
+        # deliver their responses; then close connections to unblock
+        # handlers parked in _read_frame; then cancel stragglers — looping,
+        # because buffered frames can spawn new dispatches after any
+        # one-shot snapshot. Un-awaited tasks at loop teardown are
+        # destroyed pending, which is the noise this exists to prevent.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 1.0
+        while self._dispatches and loop.time() < deadline:
+            await asyncio.wait(set(self._dispatches),
+                               timeout=deadline - loop.time())
+        for w in list(self._conns):
+            try:
+                w.close()
+            except Exception:
+                pass
+        cancel_deadline = loop.time() + 1.0
+        while self._dispatches and loop.time() < cancel_deadline:
+            stragglers = set(self._dispatches)
+            for t in stragglers:
+                t.cancel()
+            await asyncio.wait(stragglers,
+                               timeout=cancel_deadline - loop.time())
+        if self._server:
             try:
                 await self._server.wait_closed()
             except Exception:
                 pass
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._conns.add(writer)
         try:
             while True:
                 try:
                     kind, msg_id, method, payload = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
-                asyncio.get_running_loop().create_task(
+                t = asyncio.get_running_loop().create_task(
                     self._dispatch(writer, kind, msg_id, method, payload))
+                self._dispatches.add(t)
+                t.add_done_callback(self._dispatches.discard)
         finally:
+            self._conns.discard(writer)
             try:
                 writer.close()
             except Exception:
@@ -260,6 +290,8 @@ class RpcClient:
             self._writer = None
         if self._read_task:
             self._read_task.cancel()
+            await asyncio.wait([self._read_task], timeout=0.5)
+            self._read_task = None
 
 
 class ClientPool:
@@ -321,6 +353,11 @@ class EventLoopThread:
                 step = min(step, max(deadline - _time.monotonic(), 0.0))
             try:
                 return fut.result(step)
+            except asyncio.CancelledError:
+                # stop()'s drain cancelled the task under us; keep the
+                # documented contract (CancelledError is a BaseException —
+                # callers' `except Exception` handlers never see it)
+                raise ConnectionLost("runtime event loop stopped") from None
             except TimeoutError:
                 if fut.done():
                     # Completed during the poll window: surface the real
@@ -340,5 +377,35 @@ class EventLoopThread:
         self.loop.call_soon_threadsafe(_create)
 
     def stop(self):
-        self.loop.call_soon_threadsafe(self.loop.stop)
+        # Drain before stopping: a task still pending when the loop dies is
+        # destroyed un-awaited and asyncio logs "Task was destroyed but it
+        # is pending!" — in a long-lived daemon that noise is where real
+        # leaks hide, so cancel and await everything first.
+        async def _drain():
+            # Iterate: cancelling one task can spawn another (a cancelled
+            # caller's teardown may reconnect, creating a fresh _read_loop),
+            # so a one-shot snapshot can leave brand-new tasks pending.
+            cur = asyncio.current_task()
+            deadline = asyncio.get_running_loop().time() + 2.0
+            while True:
+                tasks = [t for t in asyncio.all_tasks() if t is not cur]
+                if not tasks:
+                    break
+                for t in tasks:
+                    t.cancel()
+                left = deadline - asyncio.get_running_loop().time()
+                if left <= 0:
+                    break
+                await asyncio.wait(tasks, timeout=min(left, 1.0))
+
+        if self._thread.is_alive() and self.loop.is_running():
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    _drain(), self.loop).result(3.0)
+            except Exception:
+                pass
+        try:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+        except RuntimeError:
+            pass  # loop already closed
         self._thread.join(timeout=2)

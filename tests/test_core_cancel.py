@@ -3,6 +3,7 @@ queued tasks drop from the submit queue; executing tasks get
 KeyboardInterrupt injected (force=True kills the worker); finished tasks
 are a no-op; cancelled tasks never retry."""
 
+import os
 import time
 
 import pytest
@@ -11,11 +12,15 @@ import ray_tpu
 from ray_tpu.core.status import TaskCancelledError
 
 
-def test_cancel_queued_task(ray_start_regular):
+def test_cancel_queued_task(ray_start_regular, tmp_path):
     """A task parked behind a long-running one cancels without ever
     executing."""
+    marker = str(tmp_path / "hog_started")
+
     @ray_tpu.remote(num_cpus=4)
-    def hog():
+    def hog(path):
+        with open(path, "w") as f:
+            f.write("started")
         time.sleep(8)
         return "hog"
 
@@ -23,13 +28,21 @@ def test_cancel_queued_task(ray_start_regular):
     def later():
         return "ran"
 
-    h = hog.remote()
+    h = hog.remote(marker)
+    # Barrier: wait until hog is verifiably EXECUTING (worker spawned,
+    # lease granted, all 4 CPUs held) before submitting the victim — under
+    # full-suite load worker cold-spawn can take tens of seconds, and
+    # without the barrier that spawn time eats the victim-get timeout.
+    deadline = time.time() + 90
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.1)
+    assert os.path.exists(marker), "hog never started executing"
     queued = later.remote()     # can't schedule: hog holds all 4 CPUs
-    time.sleep(0.5)
+    time.sleep(0.3)             # let the submit reach the queue
     ray_tpu.cancel(queued)
     with pytest.raises(TaskCancelledError):
-        ray_tpu.get(queued, timeout=30)
-    assert ray_tpu.get(h, timeout=60) == "hog"   # victim unaffected
+        ray_tpu.get(queued, timeout=60)
+    assert ray_tpu.get(h, timeout=120) == "hog"   # victim unaffected
 
 
 
