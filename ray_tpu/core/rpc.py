@@ -256,6 +256,12 @@ class RpcClient:
                     pass
             self._writer = None
 
+    async def connect(self) -> None:
+        """Ensure the connection is open without sending anything — lets
+        callers that need send-vs-connect failure attribution (actor task
+        dispatch) establish the link as a separate, provably-unsent step."""
+        await self._ensure()
+
     async def call(self, method: str, timeout: Optional[float] = None, **payload) -> Any:
         fut = await self.start_call(method, **payload)
         if timeout is None:

@@ -1903,6 +1903,7 @@ class Runtime:
                 try:
                     addr = await self._resolve_actor(actor_id)
                 except (ActorDiedError, ActorUnavailableError) as e:
+                    e.dispatched = False   # never left the submit queue
                     self._fail_task_returns(spec, e)
                     continue
                 except (ConnectionLost, RemoteError, OSError):
@@ -1914,8 +1915,18 @@ class Runtime:
                     continue
                 client = self.pool.get(tuple(addr))
                 try:
+                    await client.connect()
+                except (ConnectionLost, OSError) as e:
+                    # connect failed: the frame provably never left us
+                    await self._on_actor_push_failure(spec, retries, addr, e,
+                                                      dispatched=False)
+                    continue
+                try:
                     fut = await client.start_call("push_actor_task", spec=spec)
                 except (ConnectionLost, OSError) as e:
+                    # the frame was (at least partially) written before the
+                    # failure — it MAY have reached the worker, so this is
+                    # not provably unsent (drain() raises after write())
                     await self._on_actor_push_failure(spec, retries, addr, e)
                     continue
                 self.loop.create_task(
@@ -1938,9 +1949,14 @@ class Runtime:
                             worker=f"{addr[0]}:{addr[1]}")
 
     async def _on_actor_push_failure(self, spec: TaskSpec, retries: int,
-                                     addr: Address, err: Exception):
+                                     addr: Address, err: Exception, *,
+                                     dispatched: bool = True):
         """Worker connection broke: the actor may be restarting
-        (ref: direct_actor_task_submitter.h DisconnectActor/retry path)."""
+        (ref: direct_actor_task_submitter.h DisconnectActor/retry path).
+
+        ``dispatched=False`` ⇒ the push frame provably never hit the wire;
+        the surfaced error carries that so routing layers (serve proxy)
+        can safely re-dispatch non-idempotent requests."""
         actor_id = spec.actor_id
         if self._actor_addr.get(actor_id) == tuple(addr):
             self._actor_addr[actor_id] = None
@@ -1974,12 +1990,13 @@ class Runtime:
             self._spawn(self._actor_sender(actor_id))
         elif state in ("RESTARTING", "ALIVE", "PENDING_CREATION"):
             self._fail_task_returns(spec, ActorUnavailableError(
-                f"actor {actor_id.hex()[:12]} unavailable: {err}"))
+                f"actor {actor_id.hex()[:12]} unavailable: {err}",
+                dispatched=dispatched))
         else:
             cause = (view or {}).get("death_cause", str(err))
             self._fail_task_returns(spec, ActorDiedError(
                 f"actor {actor_id.hex()[:12]} died: {cause}",
-                actor_id=actor_id.hex()))
+                actor_id=actor_id.hex(), dispatched=dispatched))
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.gcs_call("kill_actor", actor_id=actor_id, no_restart=no_restart)

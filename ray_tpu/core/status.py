@@ -31,19 +31,37 @@ class ActorDiedError(RayTpuError):
 
     Carries the dead actor's id (hex) so routing layers can evict the
     exact replica locally instead of waiting for a control-plane probe
-    (ref: RayActorError.actor_id)."""
+    (ref: RayActorError.actor_id), and whether the failed call was ever
+    dispatched to the actor's worker: ``dispatched=False`` means the task
+    frame provably never reached the worker, so re-running it cannot
+    duplicate side effects — routing layers may retry it regardless of
+    idempotency (ref: router.py re-dispatches queued-but-unsent requests
+    on replica death)."""
 
-    def __init__(self, msg: str = "", actor_id: str = None):
+    def __init__(self, msg: str = "", actor_id: str = None,
+                 dispatched: bool = True):
         super().__init__(msg)
         self.actor_id = actor_id
+        self.dispatched = dispatched
 
-    def __reduce__(self):   # keep actor_id across pickling
+    def __reduce__(self):   # keep actor_id/dispatched across pickling
         return (type(self), (self.args[0] if self.args else "",
-                             self.actor_id))
+                             self.actor_id, self.dispatched))
 
 
 class ActorUnavailableError(RayTpuError):
-    """Actor is restarting; call may be retried (ref: ActorUnavailableError)."""
+    """Actor is restarting; call may be retried (ref: ActorUnavailableError).
+
+    ``dispatched`` mirrors ActorDiedError: False ⇒ the call never reached
+    the worker, so a retry is side-effect-safe for any method."""
+
+    def __init__(self, msg: str = "", dispatched: bool = True):
+        super().__init__(msg)
+        self.dispatched = dispatched
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",
+                             self.dispatched))
 
 
 class TaskCancelledError(RayTpuError):

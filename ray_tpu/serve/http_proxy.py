@@ -262,13 +262,17 @@ class HTTPProxy:
         # Retry-on-dead-replica (ref: router.py assign-and-retry): a
         # request that raced a replica death re-routes through the handle
         # (whose router gets the replacement set pushed) instead of
-        # surfacing a 500. ActorDiedError cannot distinguish "queued,
-        # never started" from "died mid-execution", so only idempotent
-        # methods (GET/HEAD) are retried — re-running a POST whose
-        # replica died mid-write would duplicate its side effects.
+        # surfacing a 500. The owner runtime stamps the error with whether
+        # the call frame ever reached the replica's worker: an UNSENT
+        # request (dispatched=False) is safe to re-dispatch for ANY verb —
+        # it provably never started, so no side effects can duplicate
+        # (ref: router.py re-dispatches queued-but-unsent requests on
+        # replica death regardless of method). Only idempotent methods
+        # (GET/HEAD) may additionally retry after an IN-FLIGHT death,
+        # where "died mid-write" cannot be ruled out.
         last_err = None
-        attempts = 3 if h.command in ("GET", "HEAD") else 1
-        for _ in range(attempts):
+        idempotent = h.command in ("GET", "HEAD")
+        for _ in range(3):
             ref = handle.remote(req)
             try:
                 result = ray_tpu.get(ref, timeout=60)
@@ -280,10 +284,13 @@ class HTTPProxy:
                 # evict the EXACT dead replica locally — the controller's
                 # next health probe (and pushed update) may be up to a
                 # second away, and re-picking from a stale set would burn
-                # every retry on the same corpse
+                # every retry on the same corpse. Evict even when about to
+                # surface the error, so later requests don't re-pick it.
                 router.evict(getattr(e, "actor_id", None))
                 if not router._replicas:
                     router._refresh(force=True)
+                if not idempotent and getattr(e, "dispatched", True):
+                    raise   # may have executed: never duplicate a POST
         raise last_err
 
     @staticmethod

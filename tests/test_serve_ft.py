@@ -125,6 +125,84 @@ def test_kill_replica_requests_survive_http(ray_start_regular):
         controller.get_replicas.remote("EchoHttp"))) == 2
 
 
+def test_kill_replica_queued_posts_survive_http(ray_start_regular):
+    """Non-idempotent requests that were never dispatched to the dead
+    replica re-route instead of surfacing a 500 (ref: router.py
+    re-dispatches queued-but-unsent requests regardless of verb), and
+    each executes exactly once — no drops, no duplicates."""
+
+    @ray_tpu.remote(num_cpus=0)
+    class HitCounter:
+        def __init__(self):
+            self.n = 0
+
+        def hit(self):
+            self.n += 1
+            return self.n
+
+        def count(self):
+            return self.n
+
+    counter = HitCounter.options(name="post_hits", lifetime="detached",
+                                 namespace="serve_test").remote()
+    ray_tpu.get(counter.count.remote(), timeout=60)
+
+    @serve.deployment
+    class Writer:
+        def __init__(self):
+            self._c = ray_tpu.get_actor("post_hits",
+                                        namespace="serve_test")
+
+        def __call__(self, req):
+            n = ray_tpu.get(self._c.hit.remote(), timeout=30)
+            return {"wrote": n}
+
+    serve.run(Writer.options(name="Writer", num_replicas=2).bind(),
+              route_prefix="/writer")
+    port = serve.start()
+
+    def post_ok():
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/writer", data=b'{"v": 1}',
+            method="POST"), timeout=60)
+        assert r.status == 200
+        return r.read()
+
+    assert b"wrote" in post_ok()
+    base = ray_tpu.get(counter.count.remote(), timeout=30)
+
+    controller = _controller()
+    victim = ray_tpu.get(controller.get_replicas.remote("Writer"))[0]
+    ray_tpu.kill(victim)
+    # wait until the replica is provably DEAD so the proxy's next pick of
+    # the corpse fails at SEND time (dispatched=False ⇒ retryable verb-
+    # independently); a request racing the in-flight window would rightly
+    # surface instead (may-have-executed)
+    deadline = time.time() + 60
+    dead = False
+    while time.time() < deadline and not dead:
+        try:
+            # nonexistent method: RemoteError while alive (side-effect
+            # free), ActorDiedError once the kill has landed
+            ray_tpu.get(victim.handle_request.remote(
+                "__no_such_method__", (), {}, None), timeout=5)
+        except ray_tpu.exceptions.ActorDiedError:
+            dead = True
+        except Exception:
+            time.sleep(0.2)
+    assert dead, "victim replica never died"
+
+    # every POST through the dead-replica window succeeds exactly once
+    n_posts = 8
+    for _ in range(n_posts):
+        assert b"wrote" in post_ok()
+    final = ray_tpu.get(counter.count.remote(), timeout=30)
+    assert final - base == n_posts, (
+        f"expected exactly {n_posts} post hits, got {final - base} "
+        "(drop or duplicate)")
+    ray_tpu.kill(ray_tpu.get_actor("post_hits", namespace="serve_test"))
+
+
 def test_autoscale_windows_unit():
     """Windowed autoscale decision logic: look-back average + up/down
     delays (ref: _private/autoscaling_policy.py), no cluster needed."""
