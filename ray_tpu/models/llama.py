@@ -752,6 +752,100 @@ def prefill_paged_tail(params, tokens, tail_len, prefix_len, page_table,
     return logits, nk, nv
 
 
+def prefill_tail_contiguous(params, tokens, tail_len, prefix_len,
+                            cache: KVCache, slot_ids, cfg: LlamaConfig
+                            ) -> Tuple[jax.Array, KVCache]:
+    """Chunked prefill of a prompt segment into CONTIGUOUS cache rows —
+    the contiguous-layout twin of prefill_paged_tail, so both KV layouts
+    share the chunked-prefill admission path (ref: vLLM chunked prefill;
+    the reference has no native engine, its serve layer delegates to user
+    code). tokens [B, T] right-padded; tail_len [B] true chunk lengths;
+    prefix_len [B] tokens already in each row; slot_ids [B] DISTINCT cache
+    rows (duplicates would make scatter order undefined). Writes the
+    chunk's KV at positions prefix..prefix+tail of each slot row, attends
+    causally over the row's full filled length, and returns (logits at
+    each row's final chunk token [B, V], cache with length[slot] advanced
+    to prefix+tail for rows with tail_len>0). Cost O(T * S) attention per
+    chunk instead of the O(S^2) full re-prefill."""
+    dt = cfg.dtype
+    B, T = tokens.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    S = cache.k.shape[2]
+    grp = H // KV
+
+    qpos = prefix_len[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    valid = jnp.arange(T)[None, :] < tail_len[:, None]           # [B, T]
+    safe_q = jnp.minimum(qpos, S - 1)
+    cos_full, sin_full = _rope_tables(cfg.rope_theta, cfg.max_seq_len,
+                                      cfg.head_dim)
+    safe_pos = jnp.minimum(qpos, cfg.max_seq_len - 1)
+    cos = cos_full[safe_pos]                                     # [B, T, HD/2]
+    sin = sin_full[safe_pos]
+
+    def rope(x):   # [B, T, N, HD]
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                               axis=-1).astype(x.dtype)
+
+    kv_pos = jnp.arange(S)[None, :]                              # [1, S]
+    total = (prefix_len + tail_len)[:, None]
+    mask = (kv_pos < total)[:, None, :] & \
+        (kv_pos[:, None, :] <= qpos[:, :, None])                 # [B, T, S]
+    if cfg.sliding_window is not None:
+        mask = mask & (qpos[:, :, None] - kv_pos[:, None, :]
+                       < cfg.sliding_window)
+
+    x = params["embed"].astype(dt)[tokens]                       # [B, T, D]
+
+    def body(x, inp):
+        lp, ck, cv = inp                          # ck: [Bslots, S, KV, HD]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = rope((h @ lp["wq"].astype(dt)).reshape(B, T, H, HD))
+        k = rope((h @ lp["wk"].astype(dt)).reshape(B, T, KV, HD))
+        v = (h @ lp["wv"].astype(dt)).reshape(B, T, KV, HD)
+        # masked scatter: pad positions write back what is already there
+        # (their safe_q indices all clamp to S-1, and last-write order is
+        # undefined for duplicates — writing the old value makes any
+        # order a no-op)
+        old_k = ck[slot_ids[:, None], safe_q]                    # [B, T, KV, HD]
+        old_v = cv[slot_ids[:, None], safe_q]
+        kw = jnp.where(valid[..., None, None], k.astype(ck.dtype), old_k)
+        vw = jnp.where(valid[..., None, None], v.astype(cv.dtype), old_v)
+        ck = ck.at[slot_ids[:, None], safe_q].set(kw)
+        cv = cv.at[slot_ids[:, None], safe_q].set(vw)
+        kg = jnp.repeat(ck[slot_ids].transpose(0, 2, 1, 3), grp, axis=1)
+        vg = jnp.repeat(cv[slot_ids].transpose(0, 2, 1, 3), grp, axis=1)
+        qh = q.transpose(0, 2, 1, 3)                             # [B, H, T, HD]
+        scores = jnp.einsum("bhtd,bhsd->bhts", qh.astype(jnp.float32),
+                            kg.astype(jnp.float32)) / (HD ** 0.5)
+        scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhts,bhsd->bhtd", probs,
+                       vg.astype(jnp.float32)).astype(dt)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, H * HD)
+        x = x + o @ lp["wo"].astype(dt)
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        return x, (ck, cv)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k,
+                                         cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    idx = jnp.clip(tail_len - 1, 0, T - 1)
+    last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    old_len = cache.length[slot_ids]
+    new_len = jnp.where(tail_len > 0,
+                        (prefix_len + tail_len).astype(old_len.dtype),
+                        old_len)
+    length = cache.length.at[slot_ids].set(new_len)
+    return logits, KVCache(nk, nv, length)
+
+
 def scatter_prefill_pages(k_pools, v_pools, ks, vs, page_table, slots,
                           lengths, page_size: int):
     """Write prefill k/v into the pools. ks/vs [L, n, P, KV, HD] (from

@@ -64,7 +64,8 @@ class LLMEngine:
                  num_pages: Optional[int] = None,
                  max_queue_depth: Optional[int] = None,
                  prefix_caching: bool = True,
-                 prefix_cache_max_tail: Optional[int] = None):
+                 prefix_cache_max_tail: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -139,6 +140,18 @@ class LLMEngine:
         self.slots: List[Optional[_Request]] = [None] * max_slots
         self.lock = threading.Lock()
         self.pending: List[_Request] = []
+        # requests that own a slot but are still mid-prefill: each _admit
+        # round advances them by one bounded chunk (ref: vLLM chunked
+        # prefill — prefill work is scheduled in chunks between decode
+        # steps instead of monopolizing a round). They are masked OUT of
+        # decode until their tail completes.
+        self._prefilling: List[_Request] = []
+        #: chunk size for the chunked-prefill path. None ⇒ plain prompts
+        #: prefill in one call (current perf behavior) and only prefix-
+        #: cache tails are chunked (at prefix_cache_max_tail tokens per
+        #: round). Set it to bound per-round prefill latency for BOTH
+        #: kv layouts.
+        self.prefill_chunk = prefill_chunk
         self._next_id = 0
         # device-resident decode state: last tokens, active mask, temps,
         # PRNG key. Uploaded only when slot membership changes — per-block
@@ -192,6 +205,12 @@ class LLMEngine:
             self._decode = jax.jit(
                 lambda p, t, c, a: llama.decode_step(p, t, c, cfg, active=a),
                 donate_argnums=(2,))  # cache aliases in place across calls
+            # chunked-prefill twin for the contiguous layout: writes a
+            # bounded token chunk into slot rows at their current fill
+            self._prefill_tail_contig = jax.jit(
+                lambda p, t, tl, pl, sl, c: llama.prefill_tail_contiguous(
+                    p, t, tl, pl, c, sl, cfg),
+                donate_argnums=(5,))
         self._prefill = jax.jit(
             lambda p, t, l: llama.prefill(p, t, l, cfg))  # noqa: E741
 
@@ -251,17 +270,26 @@ class LLMEngine:
             b *= 2
         return min(b, self.max_seq)
 
+    def _chunk_size(self) -> int:
+        """Tokens of prefill per admission round for the chunked path."""
+        if self.prefill_chunk:
+            return self.prefill_chunk
+        if self.kv_layout == "paged":
+            return self.prefix_cache_max_tail
+        return 512
+
     def _admit(self):
         import jax.numpy as jnp
 
-        cached_admits = []
+        chunk = self._chunk_size()
         with self.lock:
             free = [i for i, s in enumerate(self.slots) if s is None]
+            chunked_new = []
+            admit = []
             if self.kv_layout == "paged":
                 # FIFO admission gated on BOTH a free slot and enough
                 # free pages for the prompt — head-of-line blocks
                 # rather than starving long prompts
-                admit = []
                 for r in list(self.pending):
                     if not free:
                         break
@@ -278,71 +306,28 @@ class LLMEngine:
                         r.progress.set()
                         continue
                     if self._try_admit_cached(r, free, plen):
-                        cached_admits.append(r)
+                        chunked_new.append(r)
                         self.pending.remove(r)
                         continue
                     slot = free[0]
                     if not self.pool.grow(slot, plen):
                         break
                     free.pop(0)
-                    r.slot = slot
-                    self.slots[slot] = r
-                    admit.append(r)
+                    self._assign_slot(r, slot, plen, chunk, chunked_new,
+                                      admit)
                     self.pending.remove(r)
             else:
-                admit = self.pending[:len(free)]
-                self.pending = self.pending[len(admit):]
-                for req, slot in zip(admit, free):
-                    req.slot = slot
-                    self.slots[slot] = req
-        if cached_admits:
-            # prefix hits: KV for the matched pages already lives in the
-            # pool. ONE chunked tail-prefill call computes the unmatched
-            # tail against it (O(T * total) attention) and yields each
-            # row's first-token logits — no full re-prefill, no
-            # per-token decode draining.
-            Tb = self._bucket(max(len(r._tail) for r in cached_admits))
-            n = len(cached_admits)
-            # pad the BATCH dim to a pow2 bucket too: every distinct
-            # (n, T) shape is its own XLA program, and admission batch
-            # sizes vary request-to-request. Pad rows have tail_len 0,
-            # so their writes land in the trash page.
-            nb = 1
-            while nb < n:
-                nb *= 2
-            toks_t = np.zeros((nb, Tb), np.int32)
-            tl = np.zeros((nb,), np.int32)
-            pl = np.zeros((nb,), np.int32)
-            for i, r in enumerate(cached_admits):
-                toks_t[i, :len(r._tail)] = r._tail
-                tl[i] = len(r._tail)
-                pl[i] = r._prefix_matched
-            rows = np.zeros((nb, self.pool.table.shape[1]), np.int32)
-            rows[:n] = self.pool.table[[r.slot for r in cached_admits]]
-            tables = jnp.asarray(rows)
-            logits_t, self.kp, self.vp = self._prefill_tail(
-                self.params, jnp.asarray(toks_t), jnp.asarray(tl),
-                jnp.asarray(pl), tables, self.kp, self.vp)
-            for i, r in enumerate(cached_admits):
-                self._len_host[r.slot] = int(pl[i]) + int(tl[i])
-            upd_slots = jnp.asarray([r.slot for r in cached_admits])
-            temps_t = [r.temperature for r in cached_admits] + \
-                [0.0] * (nb - n)
-            first_t = np.asarray(self._sample(logits_t, temps_t))[:n]
-            self._last = self._last.at[upd_slots, 0].set(
-                jnp.asarray(first_t.astype(np.int32)))
-            self._masks_dirty = True
-            self._table_dirty = True
-            now = time.time()
-            for i, r in enumerate(cached_admits):
-                tok = int(first_t[i])
-                r.generated.append(tok)
-                if r.first_token_time is None:
-                    r.first_token_time = now
-                    self.metrics["ttft_sum"] += now - r.submit_time
-                    self.metrics["ttft_count"] += 1
-                self.metrics["tokens_generated"] += 1
-                self._maybe_finish(r)
+                for r in list(self.pending):
+                    if not free:
+                        break
+                    plen = min(len(r.prompt), self.max_seq - 1)
+                    self._assign_slot(r, free.pop(0), plen, chunk,
+                                      chunked_new, admit)
+                    self.pending.remove(r)
+            self._prefilling.extend(chunked_new)
+        # advance every mid-prefill request (fresh prefix hits included)
+        # by one bounded chunk — one device call for the whole set
+        self._prefill_round(chunk)
         if not admit:
             return
         P = self._bucket(max(len(r.prompt) for r in admit))
@@ -362,18 +347,7 @@ class LLMEngine:
                 jnp.asarray(lens))
             for i, r in enumerate(admit):
                 self._len_host[r.slot] = int(lens[i])
-                if self.prefix_caching and int(lens[i]) < self.max_seq:
-                    from ray_tpu.serve.paged_kv import page_chain_hashes
-
-                    # register this prompt's FULL pages for later hits
-                    # (prefill wrote their KV; they stay read-only —
-                    # decode appends past lens[i]). Prompts truncated to
-                    # the FULL max_seq window are skipped: the lookup
-                    # side views the last max_seq-1 tokens, so the page
-                    # boundaries would shift by one token and the pages'
-                    # KV wouldn't correspond to any lookup view.
-                    self.pool.register(r.slot, page_chain_hashes(
-                        r.prompt[-int(lens[i]):], self.pool.page_size))
+                r._filled = int(lens[i])
             self._len_dev = jnp.asarray(self._len_host.astype(np.int32))
             self._table_dirty = False
         else:
@@ -384,17 +358,51 @@ class LLMEngine:
             v = self.cache.v.at[:, slots, :P].set(
                 vs.astype(self.cache.v.dtype))
             length = self.cache.length.at[slots].set(jnp.asarray(lens))
+            for i, r in enumerate(admit):
+                r._filled = int(lens[i])
             from ray_tpu.models.llama import KVCache
 
             self.cache = KVCache(k, v, length)
         self._masks_dirty = True
-        first = np.asarray(self._sample(logits, [r.temperature for r in admit]))
-        self._last = self._last.at[slots, 0].set(
-            jnp.asarray(first.astype(np.int32)))
+        self._emit_first_tokens(list(enumerate(admit)), logits, len(admit))
+
+    def _assign_slot(self, r, slot: int, plen: int, chunk: int,
+                     chunked_new: list, admit: list):
+        """Bind a request to its slot (caller holds self.lock), routing
+        long prompts to the chunked-prefill path when enabled."""
+        r.slot = slot
+        self.slots[slot] = r
+        if self.prefill_chunk and plen > chunk:
+            # long prompt: bounded chunks across admission rounds
+            # instead of one monopolizing prefill
+            r._tail = list(r.prompt[-plen:])
+            r._filled = 0
+            if self.kv_layout == "paged":
+                self._len_host[slot] = 0
+            chunked_new.append(r)
+        else:
+            admit.append(r)
+
+    def _emit_first_tokens(self, pairs, logits, nb: int):
+        """Shared completion path for every prefill flavor (plain,
+        prefix-hit, chunked): sample each finished row's first token
+        from its logits row, record TTFT, register prompt pages for
+        prefix caching, and finish/notify. pairs = [(logits_row,
+        request)]; nb = the logits batch size (pad rows get temp 0)."""
+        import jax.numpy as jnp
+
+        if not pairs:
+            return
+        temps = [0.0] * nb
+        for i, r in pairs:
+            temps[i] = r.temperature
+        first = np.asarray(self._sample(logits, temps))
+        upd = jnp.asarray([r.slot for _, r in pairs])
+        self._last = self._last.at[upd, 0].set(jnp.asarray(
+            np.asarray([int(first[i]) for i, _ in pairs], np.int32)))
         now = time.time()
-        for i, r in enumerate(admit):
-            tok = int(first[i])
-            r.generated.append(tok)
+        for i, r in pairs:
+            r.generated.append(int(first[i]))
             # re-admission after a recompute-preemption must not reset
             # the client-visible TTFT or double-count the metric
             if r.first_token_time is None:
@@ -402,15 +410,92 @@ class LLMEngine:
                 self.metrics["ttft_sum"] += now - r.submit_time
                 self.metrics["ttft_count"] += 1
             self.metrics["tokens_generated"] += 1
+            if (self.kv_layout == "paged" and self.prefix_caching
+                    and r._filled < self.max_seq):
+                from ray_tpu.serve.paged_kv import page_chain_hashes
+
+                # register this prompt's FULL pages for later hits
+                # (prefill wrote their KV; they stay read-only — decode
+                # appends past the fill). Prompts truncated to the FULL
+                # max_seq window are skipped: the lookup side views the
+                # last max_seq-1 tokens, so the page boundaries would
+                # shift by one token and the pages' KV wouldn't
+                # correspond to any lookup view.
+                self.pool.register(r.slot, page_chain_hashes(
+                    list(r.prompt)[-r._filled:], self.pool.page_size))
             self._maybe_finish(r)
+            r.progress.set()
+
+    def _prefill_round(self, chunk: int):
+        """One bounded prefill chunk for every mid-prefill request, in
+        ONE device call (ref: vLLM chunked prefill scheduling — prefill
+        advances between decode steps instead of monopolizing a round).
+        Requests whose tail completes sample their first token here and
+        join the next decode step; the rest stay masked out of decode
+        and continue next round."""
+        import jax.numpy as jnp
+
+        with self.lock:
+            rows = list(self._prefilling)
+        if not rows:
+            return
+        takes = [min(len(r._tail), chunk) for r in rows]
+        Tb = self._bucket(max(takes))
+        n = len(rows)
+        if self.kv_layout == "paged":
+            # pad the BATCH dim to a pow2 bucket: every distinct (n, T)
+            # shape is its own XLA program. Pad rows have tail_len 0, so
+            # their writes land in the trash page.
+            nb = 1
+            while nb < n:
+                nb *= 2
+        else:
+            # contiguous has no trash row a pad entry could safely
+            # target, so the batch dim stays exact (bounded by
+            # max_slots distinct programs)
+            nb = n
+        toks = np.zeros((nb, Tb), np.int32)
+        tl = np.zeros((nb,), np.int32)
+        pl = np.zeros((nb,), np.int32)
+        for i, r in enumerate(rows):
+            t = r._tail[:takes[i]]
+            toks[i, :len(t)] = t
+            tl[i] = len(t)
+            pl[i] = r._filled
+        if self.kv_layout == "paged":
+            tab = np.zeros((nb, self.pool.table.shape[1]), np.int32)
+            tab[:n] = self.pool.table[[r.slot for r in rows]]
+            logits, self.kp, self.vp = self._prefill_tail(
+                self.params, jnp.asarray(toks), jnp.asarray(tl),
+                jnp.asarray(pl), jnp.asarray(tab), self.kp, self.vp)
+        else:
+            slot_ids = jnp.asarray([r.slot for r in rows], jnp.int32)
+            logits, self.cache = self._prefill_tail_contig(
+                self.params, jnp.asarray(toks), jnp.asarray(tl),
+                jnp.asarray(pl), slot_ids, self.cache)
+        finished = []
+        with self.lock:
+            for i, r in enumerate(rows):
+                r._filled += takes[i]
+                r._tail = r._tail[takes[i]:]
+                if self.kv_layout == "paged":
+                    self._len_host[r.slot] = r._filled
+                if not r._tail:
+                    finished.append((i, r))
+                    self._prefilling.remove(r)
+            self._masks_dirty = True
+            if self.kv_layout == "paged":
+                self._table_dirty = True
+        self._emit_first_tokens(finished, logits, nb)
 
     def _try_admit_cached(self, r, free: List[int], plen: int) -> bool:
         """Prefix-cache admission (caller holds self.lock): if the
         prompt's leading FULL pages are cached, adopt them — no prefill
-        compute, no new pages for the prefix. The unmatched tail
-        (bounded by prefix_cache_max_tail) is finished by ONE chunked
-        tail-prefill call in _admit. Returns False to fall back to the
-        full prefill."""
+        compute, no new pages for the prefix. The unmatched tail is
+        finished by the chunked-prefill rounds (at most
+        prefix_cache_max_tail — or prefill_chunk — tokens per round), so
+        a long tail no longer forces a full re-prefill of the matched
+        prefix. Returns False to fall back to the full prefill."""
         if not self.prefix_caching:
             return False
         from ray_tpu.serve.paged_kv import page_chain_hashes
@@ -431,8 +516,6 @@ class LLMEngine:
         if not pages:
             return False
         matched = len(pages) * self.pool.page_size
-        if plen - matched > self.prefix_cache_max_tail:
-            return False   # tail too big for the bucketed tail-prefill
         slot = free[0]
         self.pool.adopt(slot, pages)
         if not self.pool.grow(slot, plen):   # room for the tail's KV
@@ -443,7 +526,7 @@ class LLMEngine:
         self.slots[slot] = r
         self._len_host[slot] = matched       # tail-prefill advances it
         r._tail = ptoks[matched:]
-        r._prefix_matched = matched
+        r._filled = matched
         self.metrics["prefix_hits"] = \
             self.metrics.get("prefix_hits", 0) + 1
         self.metrics["prefix_hit_tokens"] = \
@@ -466,6 +549,12 @@ class LLMEngine:
 
     def _seq_len(self, r: _Request) -> int:
         return len(r.prompt) + len(r.generated) - r.overlap
+
+    @staticmethod
+    def _decode_ready(r: Optional[_Request]) -> bool:
+        """A slot participates in decode only once its prefill is
+        complete — mid-chunked-prefill rows are masked out."""
+        return r is not None and not getattr(r, "_tail", None)
 
     def _maybe_finish(self, r: _Request):
         if (len(r.generated) >= r.max_new_tokens
@@ -509,6 +598,14 @@ class LLMEngine:
             # the resume prompt changed, so its page hashes did too
             if hasattr(victim, "_page_hashes"):
                 del victim._page_hashes
+            # a mid-prefill victim restarts admission from scratch:
+            # its chunk progress lived in the released pages
+            if getattr(victim, "_tail", None):
+                victim._tail = None
+                try:
+                    self._prefilling.remove(victim)
+                except ValueError:
+                    pass
             self.pending.insert(0, victim)
             self._table_dirty = True
             self._masks_dirty = True
@@ -600,11 +697,15 @@ class LLMEngine:
 
         self._admit()
         with self.lock:
-            active_reqs = [r for r in self.slots if r is not None]
+            active_reqs = [r for r in self.slots if self._decode_ready(r)]
             active_mask = np.array(
-                [1 if s is not None else 0 for s in self.slots], np.int32)
+                [1 if self._decode_ready(s) else 0 for s in self.slots],
+                np.int32)
+            occupied = sum(1 for s in self.slots if s is not None)
         if not active_reqs:
-            return 0
+            # mid-prefill slots may still be occupied: report them so
+            # callers keep driving the engine
+            return occupied
         if self.kv_layout == "paged":
             if self._ensure_paged_capacity(1) < 1:
                 for r in list(active_reqs):
@@ -618,15 +719,17 @@ class LLMEngine:
                 return 0
             # capacity growth may have preempted a slot — re-snapshot
             with self.lock:
-                active_reqs = [r for r in self.slots if r is not None]
+                active_reqs = [r for r in self.slots
+                               if self._decode_ready(r)]
                 active_mask = np.array(
-                    [1 if s is not None else 0 for s in self.slots],
-                    np.int32)
+                    [1 if self._decode_ready(s) else 0
+                     for s in self.slots], np.int32)
                 np_temps = np.zeros((self.max_slots,), np.float32)
                 for r in active_reqs:
                     np_temps[r.slot] = r.temperature
+                occupied = sum(1 for s in self.slots if s is not None)
             if not active_reqs:
-                return 0
+                return occupied
             # temps ride along so a later fused block never samples with
             # a stale _temps_dev after this sync clears _masks_dirty
             act = self._sync_paged_device_state(active_mask, np_temps)
@@ -671,14 +774,16 @@ class LLMEngine:
 
         self._admit()
         with self.lock:
-            active_reqs = [r for r in self.slots if r is not None]
+            active_reqs = [r for r in self.slots if self._decode_ready(r)]
             active_mask = np.array(
-                [1 if s is not None else 0 for s in self.slots], np.int32)
+                [1 if self._decode_ready(s) else 0 for s in self.slots],
+                np.int32)
             temps = np.zeros((self.max_slots,), np.float32)
             for r in active_reqs:
                 temps[r.slot] = r.temperature
+            occupied = sum(1 for s in self.slots if s is not None)
         if not active_reqs:
-            return 0
+            return occupied
         n_eff = n
         for r in active_reqs:
             n_eff = min(n_eff,
@@ -697,15 +802,17 @@ class LLMEngine:
                 n_eff //= 2
             # capacity growth may have preempted a slot — re-snapshot
             with self.lock:
-                active_reqs = [r for r in self.slots if r is not None]
+                active_reqs = [r for r in self.slots
+                               if self._decode_ready(r)]
                 active_mask = np.array(
-                    [1 if s is not None else 0 for s in self.slots],
-                    np.int32)
+                    [1 if self._decode_ready(s) else 0
+                     for s in self.slots], np.int32)
                 temps = np.zeros((self.max_slots,), np.float32)
                 for r in active_reqs:
                     temps[r.slot] = r.temperature
+                occupied = sum(1 for s in self.slots if s is not None)
             if not active_reqs:
-                return 0
+                return occupied
         if n_eff <= 1:
             return self.step()
         if self.kv_layout == "paged":

