@@ -54,6 +54,12 @@ class Config:
     # spawn + the lease-grant race serially)
     worker_pool_prestart: int = -1
     max_workers_per_node: int = 8
+    # Fractional-CPU actors (0 < num_cpus < 1, no other resources) pack
+    # into shared lane-host workers, this many per process — density
+    # without a 0.5+ s interpreter spawn per actor (ref: the reference's
+    # one-process-per-actor model tops out at worker-spawn rate; its 40k
+    # actor benchmark uses num_cpus=0.001). 0 disables lane packing.
+    actor_lanes_per_worker: int = 16
     worker_idle_timeout_s: float = 300.0
     scheduler_spread_threshold: float = 0.5      # ref: RAY_scheduler_spread_threshold
     scheduler_top_k_fraction: float = 0.2        # ref: hybrid_scheduling_policy.h:29

@@ -390,9 +390,13 @@ class GcsServer:
 
     async def rpc_report_worker_death(self, worker_id: bytes, node_id: NodeID,
                                       intentional: bool = False,
-                                      reason: str = "worker died") -> dict:
+                                      reason: str = "worker died",
+                                      actor_id=None) -> dict:
+        # actor_id scopes the report to one lane of a lane-host worker
+        # (the process survives, only that actor died)
         for rec in list(self.actors.values()):
-            if rec.worker_id == worker_id and rec.state == ALIVE:
+            if rec.worker_id == worker_id and rec.state == ALIVE and (
+                    actor_id is None or rec.actor_id == actor_id):
                 if intentional:
                     rec.state = DEAD
                     rec.death_cause = reason
@@ -410,8 +414,9 @@ class GcsServer:
         if rec.address is not None and rec.node_id in self.nodes:
             client = self.pool.get(self.nodes[rec.node_id].nodelet_addr)
             try:
+                # actor_id lets a lane-host nodelet kill ONLY this lane
                 await client.call("kill_worker", worker_id=rec.worker_id,
-                                  reason="ray_tpu.kill")
+                                  actor_id=actor_id, reason="ray_tpu.kill")
             except (ConnectionLost, RemoteError, OSError):
                 pass
         if no_restart:

@@ -60,6 +60,8 @@ class WorkerRecord:
         self.retriable = True          # current task retries on worker death
         self.resources_released = False  # blocked in get(); CPU given back
         self.actor_id = None           # set when this worker hosts an actor
+        self.lane_host = False         # hosts multiple fractional actors
+        self.lanes: Dict = {}          # actor_id -> ResourceSet (lane hosts)
         self.ready = asyncio.Event()
 
 
@@ -124,6 +126,7 @@ class Nodelet:
         self._xfer_ports: Dict[Tuple, Tuple[int, float]] = {}
         self._hb_seq = 0
         self._stopping = False
+        self._lane_locks: Dict[str, asyncio.Lock] = {}
         self.memory_monitor = MemoryMonitor(
             cfg.memory_usage_threshold, cfg.memory_monitor_test_usage_file)
 
@@ -288,6 +291,12 @@ class Nodelet:
         self.workers.pop(w.worker_id, None)
         if w.lease_id is not None:
             self._release_lease(w.lease_id)
+        # a dead lane host gives back every lane's fractional resources
+        for res in w.lanes.values():
+            self.available.add(res)
+        w.lanes = {}
+        if w.lane_host:
+            self._drain_pending()
         # a death frees a pool slot: wake saturated lease waiters so a
         # replacement spawns now, not at the 0.5 s wait cap
         self._worker_idle.set()
@@ -345,21 +354,32 @@ class Nodelet:
                 pass
 
     def _hosted_actors(self) -> dict:
-        return {w.actor_id.hex(): {"addr": w.addr, "worker_id": w.worker_id}
-                for w in self.workers.values()
-                if w.state == "actor" and w.actor_id is not None
-                and w.addr is not None}
+        out = {}
+        for w in self.workers.values():
+            if w.state != "actor" or w.addr is None:
+                continue
+            if w.lane_host:
+                for aid in w.lanes:
+                    out[aid.hex()] = {"addr": w.addr,
+                                      "worker_id": w.worker_id}
+            elif w.actor_id is not None:
+                out[w.actor_id.hex()] = {"addr": w.addr,
+                                         "worker_id": w.worker_id}
+        return out
 
-    async def _report_worker_death(self, w: WorkerRecord, reason: str):
+    async def _report_worker_death(self, w: WorkerRecord, reason: str,
+                                   actor_id=None):
         # Durable best-effort: the GCS may be mid-restart; keep retrying
         # through the failover window so actor FSMs see the death
-        # (ref: raylet death reports + GCS reconnect).
+        # (ref: raylet death reports + GCS reconnect). actor_id scopes the
+        # report to ONE lane of a surviving lane-host worker.
         deadline = time.time() + self.cfg.gcs_reconnect_timeout_s
         while not self._stopping:
             try:
                 await self.pool.get(self.gcs_addr).call(
                     "report_worker_death", worker_id=w.worker_id,
-                    node_id=self.node_id, reason=reason, timeout=5.0)
+                    node_id=self.node_id, reason=reason,
+                    actor_id=actor_id, timeout=5.0)
                 return
             except Exception:
                 if time.time() >= deadline:
@@ -465,10 +485,32 @@ class Nodelet:
                 "workers": {w.worker_id.hex()[:12]: r
                             for w, r in zip(live, results)}}
 
-    async def rpc_kill_worker(self, worker_id: bytes, reason: str = "") -> dict:
+    async def rpc_kill_worker(self, worker_id: bytes, reason: str = "",
+                              actor_id=None) -> dict:
         w = self.workers.get(worker_id)
-        if w is not None:
-            self._kill_worker(w, reason or "requested")
+        if w is None:
+            return {"ok": True}
+        if actor_id is not None and w.lane_host:
+            # lane-scoped kill: only this actor dies, the host (and its
+            # other lanes) lives on
+            res = w.lanes.pop(actor_id, None)
+            if res is not None:
+                self.available.add(res)
+                self._drain_pending()
+            try:
+                await self.pool.get(tuple(w.addr)).call(
+                    "destroy_actor", actor_id=actor_id, timeout=10.0)
+            except (ConnectionLost, RemoteError, OSError) as e:
+                self._kill_worker(w, f"lane destroy failed: {e}")
+                return {"ok": True}
+            # actor-scoped death report so the GCS actor FSM sees it
+            # (the host process survives, so no worker-death event fires)
+            loop = asyncio.get_running_loop()
+            loop.create_task(self._report_worker_death(
+                w, reason or "requested", actor_id=actor_id))
+            self._lane_host_maybe_idle(w)
+            return {"ok": True}
+        self._kill_worker(w, reason or "requested")
         return {"ok": True}
 
     def _countable_workers(self) -> int:
@@ -666,9 +708,122 @@ class Nodelet:
 
     # ----------------------------------------------------------------- actors
 
+    def _laneable(self, spec: TaskSpec) -> bool:
+        """Lane-host candidates: strictly fractional CPU, nothing else.
+        num_cpus>=1 and custom/TPU-resource actors keep dedicated workers
+        (process isolation + the lease protocol's accounting); PG actors
+        keep the bundle-accounted lease path."""
+        if self.cfg.actor_lanes_per_worker <= 0:
+            return False
+        if spec.scheduling.kind == "PLACEMENT_GROUP":
+            return False
+        q = spec.resources.quantities
+        cpu = q.get("CPU", 0.0)
+        return 0.0 < cpu < 1.0 and all(
+            v == 0 for k, v in q.items() if k != "CPU")
+
+    async def _create_actor_lane(self, spec: TaskSpec) -> dict:
+        """Pack a fractional-CPU actor into a shared lane-host worker
+        (one spawn amortizes over actor_lanes_per_worker actors — the
+        density path the reference reaches with 0.001-CPU actors across
+        its prestarted per-CPU worker fleet)."""
+        from ray_tpu.runtime_env import process_env
+
+        env_vars = process_env(spec.runtime_env)
+        key = _env_key(env_vars)
+        # serialize host acquisition per pool key: a burst of concurrent
+        # creates must PACK into one spawning host, not each spawn its own
+        lock = self._lane_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            if not spec.resources.fits_in(self.available):
+                return {"ok": False, "retryable": True,
+                        "error": "insufficient node resources for actor "
+                                 "lane"}
+            host = None
+            for w in self.workers.values():
+                if (w.state == "actor" and w.lane_host and w.env_key == key
+                        and w.job_id == spec.job_id.binary()
+                        and len(w.lanes) < self.cfg.actor_lanes_per_worker):
+                    host = w
+                    break
+            if host is None:
+                # fail fast at the worker cap instead of waiting inside
+                # the lane lock (the GCS retries at 0.2 s; a long wait
+                # here would head-of-line-block creates that could fill
+                # lanes freed in the meantime)
+                has_idle = any(
+                    w.state == "idle" and w.env_key == key
+                    for w in self.workers.values())
+                if not has_idle and self._countable_workers() >= \
+                        self.cfg.max_workers_per_node:
+                    return {"ok": False, "retryable": True,
+                            "error": "lane capacity exhausted "
+                                     "(max_workers_per_node x "
+                                     "actor_lanes_per_worker); retry"}
+                host = await self._pop_worker(env_vars)
+                if host is None:
+                    return {"ok": False, "retryable": True,
+                            "error": "no worker available for lane host"}
+                host.state = "actor"
+                host.lane_host = True
+                host.job_id = spec.job_id.binary()
+            # reserve under the lock; the creation RPC itself runs outside
+            # it so lane ctors still overlap
+            self.available.subtract(spec.resources)
+            host.lanes[spec.actor_id] = spec.resources.copy()
+        client = self.pool.get(tuple(host.addr))
+        try:
+            res = await client.call("create_actor", spec=spec,
+                                    timeout=self.cfg.worker_start_timeout_s)
+        except ConnectionLost as e:
+            # transport broke: the host process is gone/wedged — killing
+            # it death-reports every lane for restart
+            self.available.add(host.lanes.pop(spec.actor_id,
+                                              spec.resources))
+            self._kill_worker(host, f"lane creation rpc failed: {e}")
+            return {"ok": False, "retryable": True, "error": str(e)}
+        except (RemoteError, OSError) as e:
+            # THIS lane's creation failed (ctor hang past the deadline,
+            # or a handler error); sibling lanes are healthy — tombstone
+            # the lane worker-side so a late-finishing ctor can't install
+            # a zombie, and keep the host
+            self.available.add(host.lanes.pop(spec.actor_id,
+                                              spec.resources))
+            try:
+                await client.call("destroy_actor", actor_id=spec.actor_id,
+                                  timeout=5.0)
+            except Exception:
+                pass
+            self._lane_host_maybe_idle(host)
+            return {"ok": False, "retryable": True, "error": str(e)}
+        if not res.get("ok"):
+            # ctor raised: the host process is healthy — only the lane dies
+            self.available.add(host.lanes.pop(spec.actor_id,
+                                              spec.resources))
+            self._lane_host_maybe_idle(host)
+            return {"ok": False, "retryable": False,
+                    "error": res.get("error")}
+        return {"ok": True, "worker_addr": host.addr,
+                "worker_id": host.worker_id}
+
+    def _lane_host_maybe_idle(self, w: WorkerRecord):
+        """An empty lane host returns to the idle pool (reusable by any
+        lease, reclaimed by the idle reaper) instead of sitting in state
+        'actor' forever holding a max_workers_per_node slot."""
+        if w.lane_host and not w.lanes and w.state == "actor":
+            w.state = "idle"
+            w.lane_host = False
+            w.actor_id = None
+            w.job_id = None
+            w.last_idle = time.time()
+            self._worker_idle.set()
+
     async def rpc_create_actor(self, spec: TaskSpec) -> dict:
         """Lease a dedicated worker and run the creation task on it
-        (ref: gcs_actor_scheduler leases from raylet + pushes creation)."""
+        (ref: gcs_actor_scheduler leases from raylet + pushes creation).
+        Fractional-CPU actors take the lane path instead."""
+        if self._laneable(spec):
+            return await self._create_actor_lane(spec)
         pg = None
         if spec.scheduling.kind == "PLACEMENT_GROUP":
             pg = (spec.scheduling.pg_id, spec.scheduling.bundle_index)

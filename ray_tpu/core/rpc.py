@@ -190,7 +190,12 @@ class RpcServer:
             if kind == REQUEST:
                 writer.write(_frame((RESPONSE_OK, msg_id, method, res)))
                 await writer.drain()
-        except Exception:
+        except BaseException:
+            # BaseException: a handler awaiting a cancelled executor
+            # future raises CancelledError — the caller must still get a
+            # RESPONSE_ERR, or its pending future hangs forever. (During
+            # server stop the writer is already closed, so the write
+            # below fails silently and cancellation proceeds.)
             if known:
                 self._stat(method)["errors"] += 1
             if kind == REQUEST:

@@ -18,7 +18,8 @@ import os
 import sys
 import threading
 import traceback
-from concurrent.futures import ThreadPoolExecutor
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
 
 from ray_tpu.core import serialization
@@ -31,6 +32,70 @@ from ray_tpu.core.serialization import SerializedException
 logger = logging.getLogger("ray_tpu.worker")
 
 
+class _SerialLaneExecutor:
+    """FIFO serial execution multiplexed onto a SHARED thread pool:
+    per-lane actor ordering without a dedicated OS thread per lane (256
+    lanes/process would otherwise pin 256 permanently idle threads once
+    each actor has run a method). At most one submission per lane runs
+    at a time; drains chain through the shared pool."""
+
+    def __init__(self, pool: ThreadPoolExecutor):
+        self._pool = pool
+        self._q: deque = deque()
+        self._running = False
+        self._lock = threading.Lock()
+
+    def submit(self, fn, *args, **kw) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            self._q.append((fut, fn, args, kw))
+            if not self._running:
+                self._running = True
+                self._pool.submit(self._drain)
+        return fut
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if not self._q:
+                    self._running = False
+                    return
+                fut, fn, args, kw = self._q.popleft()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kw))
+            except BaseException as e:
+                fut.set_exception(e)
+
+    def shutdown(self, wait: bool = False, cancel_futures: bool = False):
+        if cancel_futures:
+            with self._lock:
+                q, self._q = list(self._q), deque()
+            for fut, *_ in q:
+                fut.cancel()
+
+
+class _ActorLane:
+    """One hosted actor: instance + its own serial executor lane, so N
+    fractional-CPU actors can share a worker process while each keeps the
+    FIFO ordering (or max_concurrency pool) of a dedicated worker (ref:
+    worker_pool.h one-process-per-actor; the lane design trades process
+    isolation for spawn-free density on num_cpus<1 actors)."""
+
+    def __init__(self, spec: TaskSpec, shared_pool: ThreadPoolExecutor):
+        self.spec = spec
+        self.instance: Any = None
+        if spec.max_concurrency > 1:
+            self.executor: Any = ThreadPoolExecutor(
+                max_workers=spec.max_concurrency,
+                thread_name_prefix=f"actor-{spec.actor_id.hex()[:8]}")
+        else:
+            self.executor = _SerialLaneExecutor(shared_pool)
+        self.async_sem = asyncio.Semaphore(max(1, spec.max_concurrency))
+        self.executing: set = set()       # task ids currently in _execute
+
+
 class Worker:
     """RPC handler for the worker process; delegates ownership-protocol
     methods to the embedded Runtime (every worker is also an owner)."""
@@ -39,9 +104,16 @@ class Worker:
         self.runtime = runtime
         self.task_executor = ThreadPoolExecutor(max_workers=1,
                                                 thread_name_prefix="task-exec")
-        self.actor_instance: Any = None
-        self.actor_spec: Optional[TaskSpec] = None
-        self._async_sem: Optional[asyncio.Semaphore] = None
+        # hosted actors by id — a dedicated actor worker is simply a
+        # one-lane host. Serial lanes share this bounded pool; a lane
+        # blocking in get() holds one of its threads, so the cap is
+        # generous relative to lanes-per-worker.
+        self.lanes: dict = {}
+        self._lane_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="lane-exec")
+        # ids destroyed mid-creation: a create whose ctor outlives the
+        # destroy must not install a zombie lane
+        self._destroyed: set = set()
         # cancellation (ref: core worker CancelTask -> KeyboardInterrupt
         # in the executing thread): task_id -> executing thread ident,
         # plus the set of ids whose interrupt means CANCELLED, not ctrl-C
@@ -172,11 +244,14 @@ class Worker:
 
         # Actor methods inherit the actor's creation env (ref: actor-level
         # runtime_env applies to all its tasks).
-        env = spec.runtime_env or (self.actor_spec.runtime_env
-                                   if self.actor_spec else None)
+        lane = (self.lanes.get(spec.actor_id)
+                if spec.is_actor_call else None)
+        env = spec.runtime_env or (lane.spec.runtime_env if lane else None)
         self.runtime.set_exec_context(spec.task_id, runtime_env=env)
         with self._cancel_lock:
             self._exec_threads[spec.task_id] = threading.get_ident()
+            if lane is not None:
+                lane.executing.add(spec.task_id)
         try:
             from ray_tpu.util.tracing import continue_trace
 
@@ -214,6 +289,8 @@ class Worker:
             with self._cancel_lock:
                 self._exec_threads.pop(spec.task_id, None)
                 self._cancelled.discard(spec.task_id)
+                if lane is not None:
+                    lane.executing.discard(spec.task_id)
             self.runtime.clear_exec_context()
 
     # ------------------------------------------------------------ rpc surface
@@ -228,11 +305,8 @@ class Worker:
         return result
 
     async def rpc_create_actor(self, spec: TaskSpec) -> dict:
-        self.actor_spec = spec
-        if spec.max_concurrency > 1:
-            self.task_executor = ThreadPoolExecutor(
-                max_workers=spec.max_concurrency, thread_name_prefix="actor-exec")
-        self._async_sem = asyncio.Semaphore(max(1, spec.max_concurrency))
+        self._destroyed.discard(spec.actor_id)   # fresh incarnation
+        lane = _ActorLane(spec, self._lane_pool)
 
         def _ctor():
             from ray_tpu.runtime_env import TaskEnvContext
@@ -241,16 +315,18 @@ class Worker:
             self.runtime.set_exec_context(spec.task_id,
                                           runtime_env=spec.runtime_env)
             try:
-                # The actor owns this worker: its runtime env persists for
+                # The actor owns its lane: its runtime env persists for
                 # the actor's lifetime (entered, never exited — ref: actors
-                # run in env-dedicated workers).
+                # run in env-dedicated workers; lane hosts are pooled by
+                # the same process-env key, so lanes never need
+                # conflicting process envs).
                 TaskEnvContext(self.runtime, spec.runtime_env).__enter__()
                 cls = self.runtime.load_function(spec.func_id)
                 args, kwargs = self._resolve_args(spec)
                 with continue_trace(spec.trace_ctx,
                                     f"actor::{spec.name}.__init__",
                                     {"actor_id": spec.actor_id.hex()}):
-                    self.actor_instance = cls(*args, **kwargs)
+                    lane.instance = cls(*args, **kwargs)
                 self.runtime.flush_task_events()
                 return {"ok": True}
             except BaseException:
@@ -259,12 +335,51 @@ class Worker:
                 self.runtime.clear_exec_context()
 
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(self.task_executor, _ctor)
+        res = await loop.run_in_executor(lane.executor, _ctor)
+        if spec.actor_id in self._destroyed:
+            # destroyed while the ctor ran (creation-timeout path): do
+            # not install a zombie lane the control plane stopped tracking
+            self._destroyed.discard(spec.actor_id)
+            lane.executor.shutdown(wait=False)
+            lane.instance = None
+            return {"ok": False, "error": "actor destroyed during creation"}
+        if res.get("ok"):
+            self.lanes[spec.actor_id] = lane
+        else:
+            lane.executor.shutdown(wait=False)
+        return res
+
+    async def rpc_destroy_actor(self, actor_id) -> dict:
+        """Tear down ONE lane without touching the process (the lane twin
+        of kill_worker): interrupt its executing sync methods, cancel its
+        queue, drop the instance. Other lanes are unaffected. Async
+        methods already past their semaphore run to completion (kill
+        races execution the same way on a dedicated worker); sem-queued
+        ones fail the post-acquire liveness check."""
+        import ctypes
+
+        lane = self.lanes.pop(actor_id, None)
+        if lane is None:
+            # creation may still be in flight: tombstone it
+            self._destroyed.add(actor_id)
+            return {"ok": False, "error": "no such lane"}
+        with self._cancel_lock:
+            for tid in list(lane.executing):
+                ident = self._exec_threads.get(tid)
+                if ident is not None:
+                    self._cancelled.add(tid)
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(ident),
+                        ctypes.py_object(KeyboardInterrupt))
+        lane.executor.shutdown(wait=False, cancel_futures=True)
+        lane.instance = None
+        return {"ok": True}
 
     async def rpc_push_actor_task(self, spec: TaskSpec) -> TaskResult:
-        if self.actor_instance is None:
+        lane = self.lanes.get(spec.actor_id)
+        if lane is None or lane.instance is None:
             raise RuntimeError("no actor hosted here")
-        method = getattr(self.actor_instance, spec.method_name, None)
+        method = getattr(lane.instance, spec.method_name, None)
         if method is None:
             def method(*a, **k):
                 raise AttributeError(
@@ -274,11 +389,14 @@ class Worker:
             # path): items are produced and reported on the loop;
             # serialization hops to an executor thread because packaging
             # large items blocks on the nodelet pin RPC.
-            async with self._async_sem:
+            async with lane.async_sem:
+                if self.lanes.get(spec.actor_id) is not lane or \
+                        lane.instance is None:
+                    raise RuntimeError("no actor hosted here")
                 loop = asyncio.get_running_loop()
                 try:
                     args, kwargs = await loop.run_in_executor(
-                        self.task_executor, self._resolve_args, spec)
+                        lane.executor, self._resolve_args, spec)
                     self.runtime.set_exec_context(spec.task_id)
                     agen = method(*args, **kwargs)
                     idx = 0
@@ -303,11 +421,14 @@ class Worker:
             # async actor: method coroutine runs on the loop (ref: fibers,
             # fiber.h); arg resolution still happens off-loop because it may
             # block on remote gets.
-            async with self._async_sem:
+            async with lane.async_sem:
+                if self.lanes.get(spec.actor_id) is not lane or \
+                        lane.instance is None:
+                    raise RuntimeError("no actor hosted here")
                 loop = asyncio.get_running_loop()
                 try:
                     args, kwargs = await loop.run_in_executor(
-                        self.task_executor, self._resolve_args, spec)
+                        lane.executor, self._resolve_args, spec)
                     self.runtime.set_exec_context(spec.task_id)
                     value = await method(*args, **kwargs)
                     return self._package_returns(spec, value)
@@ -318,7 +439,7 @@ class Worker:
                 finally:
                     self.runtime.clear_exec_context()
         loop = asyncio.get_running_loop()
-        result = await loop.run_in_executor(self.task_executor, self._execute,
+        result = await loop.run_in_executor(lane.executor, self._execute,
                                             spec, method)
         self.runtime.flush_task_events()
         return result

@@ -135,12 +135,15 @@ def bench_streaming_returns(n: int):
 
 
 def bench_actors(n: int):
-    """N concurrent actors on one node (ref envelope: 40k cluster-wide
-    on 4096 cores). Zero-CPU actors so scheduling, not resources, is the
-    limit; one round-trip call each proves liveness."""
+    """N concurrent actors on one node (ref envelope: 40k cluster-wide on
+    4096 cores, num_cpus=0.001 each — release/benchmarks/README.md:12).
+    Fractional-CPU actors take the multi-actor lane path: one worker
+    process hosts actor_lanes_per_worker lanes, so density is bounded by
+    lane capacity, not by 0.5+ s interpreter spawns. One round-trip call
+    each proves liveness."""
     import ray_tpu
 
-    @ray_tpu.remote(num_cpus=0)
+    @ray_tpu.remote(num_cpus=0.001)
     class A:
         def pid(self):
             return os.getpid()
@@ -226,7 +229,7 @@ def main():
         "args": 2_000 if args.full else 500,
         "returns": 1_000 if args.full else 200,
         "stream": 5_000 if args.full else 500,
-        "actors": args.actors or (200 if args.full else 50),
+        "actors": args.actors or (2_000 if args.full else 50),
         "bcast_nodes": args.bcast_nodes or (4 if args.full else 2),
         "bcast_mib": args.bcast_mib or (256 if args.full else 64),
     }
@@ -237,8 +240,11 @@ def main():
     single_node = [s for s in stages if s != "broadcast"]
     if single_node:
         ray_tpu.init(num_cpus=8, _system_config={
-            # actors hold dedicated workers; the pool must cover the fleet
-            "max_workers_per_node": max(64, scale["actors"] + 16),
+            # fractional actors pack into lane hosts (256/process); the
+            # worker cap only needs to cover hosts + task workers
+            "actor_lanes_per_worker": 256,
+            "max_workers_per_node": max(
+                64, scale["actors"] // 256 + 32),
             "worker_start_timeout_s": 300.0,
             # a 200-process fork storm on one vCPU starves heartbeats;
             # widen the failure window so slowness isn't "death"

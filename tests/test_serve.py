@@ -31,16 +31,21 @@ def test_deployment_multi_replica_and_methods(ray_start_regular):
         def __call__(self, x):
             return self.base + x
 
-        def pid(self):
+        def ident(self):
+            # (pid, instance id), not pid alone: fractional-CPU replicas
+            # may share a lane-host worker process (r5 actor lanes); and
+            # id() alone could collide across two identically-spawned
+            # processes
             import os
 
-            return os.getpid()
+            return (os.getpid(), id(self))
 
     handle = serve.run(Svc.bind(100))
     outs = ray_tpu.get([handle.remote(i) for i in range(10)])
     assert outs == [100 + i for i in range(10)]
-    pids = set(ray_tpu.get([handle.method("pid").remote() for _ in range(10)]))
-    assert len(pids) == 2, "requests should spread over both replicas"
+    idents = set(ray_tpu.get(
+        [handle.method("ident").remote() for _ in range(10)]))
+    assert len(idents) == 2, "requests should spread over both replicas"
     serve.shutdown()
 
 
