@@ -750,10 +750,10 @@ class Nodelet:
                 # fail fast at the worker cap instead of waiting inside
                 # the lane lock (the GCS retries at 0.2 s; a long wait
                 # here would head-of-line-block creates that could fill
-                # lanes freed in the meantime)
-                has_idle = any(
-                    w.state == "idle" and w.env_key == key
-                    for w in self.workers.values())
+                # lanes freed in the meantime). ANY idle worker counts:
+                # _pop_worker evicts mismatched-env idles immediately.
+                has_idle = any(w.state == "idle"
+                               for w in self.workers.values())
                 if not has_idle and self._countable_workers() >= \
                         self.cfg.max_workers_per_node:
                     return {"ok": False, "retryable": True,
@@ -764,6 +764,14 @@ class Nodelet:
                 if host is None:
                     return {"ok": False, "retryable": True,
                             "error": "no worker available for lane host"}
+                # the admission check above is stale after the await
+                # (leases draw on the same pool concurrently): re-check
+                # before reserving, or available goes negative
+                if not spec.resources.fits_in(self.available):
+                    self._worker_idle.set()   # host stays idle in pool
+                    return {"ok": False, "retryable": True,
+                            "error": "insufficient node resources for "
+                                     "actor lane"}
                 host.state = "actor"
                 host.lane_host = True
                 host.job_id = spec.job_id.binary()
@@ -778,8 +786,7 @@ class Nodelet:
         except ConnectionLost as e:
             # transport broke: the host process is gone/wedged — killing
             # it death-reports every lane for restart
-            self.available.add(host.lanes.pop(spec.actor_id,
-                                              spec.resources))
+            self._lane_rollback(host, spec.actor_id)
             self._kill_worker(host, f"lane creation rpc failed: {e}")
             return {"ok": False, "retryable": True, "error": str(e)}
         except (RemoteError, OSError) as e:
@@ -787,8 +794,7 @@ class Nodelet:
             # or a handler error); sibling lanes are healthy — tombstone
             # the lane worker-side so a late-finishing ctor can't install
             # a zombie, and keep the host
-            self.available.add(host.lanes.pop(spec.actor_id,
-                                              spec.resources))
+            self._lane_rollback(host, spec.actor_id)
             try:
                 await client.call("destroy_actor", actor_id=spec.actor_id,
                                   timeout=5.0)
@@ -798,13 +804,21 @@ class Nodelet:
             return {"ok": False, "retryable": True, "error": str(e)}
         if not res.get("ok"):
             # ctor raised: the host process is healthy — only the lane dies
-            self.available.add(host.lanes.pop(spec.actor_id,
-                                              spec.resources))
+            self._lane_rollback(host, spec.actor_id)
             self._lane_host_maybe_idle(host)
             return {"ok": False, "retryable": False,
                     "error": res.get("error")}
         return {"ok": True, "worker_addr": host.addr,
                 "worker_id": host.worker_id}
+
+    def _lane_rollback(self, host: WorkerRecord, actor_id):
+        """Return a reserved lane's resources exactly once: if the host
+        died mid-create, _on_worker_dead already cleared w.lanes and
+        refunded them — a defaulted pop would double-add and inflate
+        self.available past the node total."""
+        res = host.lanes.pop(actor_id, None)
+        if res is not None:
+            self.available.add(res)
 
     def _lane_host_maybe_idle(self, w: WorkerRecord):
         """An empty lane host returns to the idle pool (reusable by any

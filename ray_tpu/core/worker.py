@@ -105,12 +105,16 @@ class Worker:
         self.task_executor = ThreadPoolExecutor(max_workers=1,
                                                 thread_name_prefix="task-exec")
         # hosted actors by id — a dedicated actor worker is simply a
-        # one-lane host. Serial lanes share this bounded pool; a lane
-        # blocking in get() holds one of its threads, so the cap is
-        # generous relative to lanes-per-worker.
+        # one-lane host. Serial lanes share this pool; its cap matches
+        # lanes-per-worker so every lane can hold a thread even when all
+        # of them block in ray_tpu.get() simultaneously (a smaller cap
+        # could deadlock lanes that produce each other's results).
+        # Threads spawn on demand, so the resident count tracks the
+        # high-water mark of CONCURRENT lane work, not the lane count.
         self.lanes: dict = {}
         self._lane_pool = ThreadPoolExecutor(
-            max_workers=32, thread_name_prefix="lane-exec")
+            max_workers=max(32, runtime.cfg.actor_lanes_per_worker),
+            thread_name_prefix="lane-exec")
         # ids destroyed mid-creation: a create whose ctor outlives the
         # destroy must not install a zombie lane
         self._destroyed: set = set()

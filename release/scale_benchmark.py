@@ -225,9 +225,9 @@ def main():
     scale = {
         "tasks": args.tasks or (100_000 if args.full else 2_000),
         "wait": 10_000 if args.full else 2_000,
-        "get": 5_000 if args.full else 1_000,
-        "args": 2_000 if args.full else 500,
-        "returns": 1_000 if args.full else 200,
+        "get": 10_000 if args.full else 1_000,
+        "args": 10_000 if args.full else 500,
+        "returns": 3_000 if args.full else 200,
         "stream": 5_000 if args.full else 500,
         "actors": args.actors or (2_000 if args.full else 50),
         "bcast_nodes": args.bcast_nodes or (4 if args.full else 2),
