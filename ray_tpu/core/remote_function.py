@@ -24,6 +24,12 @@ class RemoteFunction:
     def __init__(self, fn: Callable, options: Optional[Dict[str, Any]] = None):
         self._fn = fn
         self._options = dict(options or {})
+        # options are immutable per RemoteFunction: build the ResourceSet
+        # once, not per .remote() call (deep queues submit millions; the
+        # spec pickles a copy on the wire, nothing mutates it owner-side)
+        self._resources = ResourceSet.from_options(
+            self._options.get("num_cpus"), self._options.get("num_tpus"),
+            self._options.get("memory"), self._options.get("resources"))
         functools.update_wrapper(self, fn)
 
     def options(self, **opts) -> "RemoteFunction":
@@ -39,9 +45,6 @@ class RemoteFunction:
 
         o = self._options
         runtime = rt.get_runtime()
-        resources = ResourceSet.from_options(
-            o.get("num_cpus"), o.get("num_tpus"), o.get("memory"),
-            o.get("resources"))
         nr = o.get("num_returns", 1)
         if nr in ("streaming", "dynamic"):
             nr = STREAMING   # generator task (ref: num_returns="dynamic")
@@ -49,10 +52,10 @@ class RemoteFunction:
             self._fn, args, kwargs,
             name=o.get("name") or getattr(self._fn, "__name__", "task"),
             num_returns=nr,
-            resources=resources,
+            resources=self._resources,
             max_retries=o.get("max_retries"),
             retry_exceptions=o.get("retry_exceptions", False),
-            scheduling=o.get("scheduling_strategy") or SchedulingStrategy(),
+            scheduling=o.get("scheduling_strategy"),
             runtime_env=o.get("runtime_env"),
             generator_backpressure=o.get("generator_backpressure"),
             generator_backpressure_bytes=o.get(

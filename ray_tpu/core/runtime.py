@@ -73,29 +73,115 @@ def set_runtime(rt: Optional["Runtime"]):
         _global_runtime = rt
 
 
-class _ObjectEntry:
-    """Owner-side directory entry (ref: ObjectDirectory + memory store)."""
+class _LazyEvent:
+    """threading.Event's API with the Condition materialized only when a
+    thread actually blocks: most owner entries complete without a
+    blocking waiter, and a real Event costs ~1 KB (condition + lock +
+    waiter deque) — the dominant term of deep-queue driver RSS (1M
+    queued tasks held ~3.9 GB in r4, mostly entry events)."""
 
-    __slots__ = ("state", "inline", "locations", "error", "event", "spec",
-                 "size", "primaries", "waiters")
+    __slots__ = ("_flag", "_ev")
+    _mat_lock = threading.Lock()
+
+    def __init__(self):
+        self._flag = False
+        self._ev: Optional[threading.Event] = None
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self):
+        self._flag = True
+        ev = self._ev
+        if ev is not None:
+            ev.set()
+            # blocked waiters hold their own reference and have been
+            # woken; every future wait() takes the flag fast path — keep
+            # none of the ~1 KB Condition machinery on completed entries
+            self._ev = None
+
+    def clear(self):
+        self._flag = False
+        ev = self._ev
+        if ev is not None:
+            ev.clear()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._flag:
+            return True
+        with _LazyEvent._mat_lock:
+            ev = self._ev
+            if ev is None:
+                ev = self._ev = threading.Event()
+        if self._flag:
+            # a set() raced materialization: it may have read _ev before
+            # the store above — settle the real event ourselves
+            ev.set()
+            return True
+        return ev.wait(timeout)
+
+
+class _ObjectEntry:
+    """Owner-side directory entry (ref: ObjectDirectory + memory store).
+
+    Location sets and the waiter list materialize on first use: deep
+    queues create millions of entries whose inline fast path never
+    touches them (~650 B/entry saved). Hot per-task paths read the
+    _-prefixed slots directly to avoid materializing empties."""
+
+    __slots__ = ("state", "inline", "_locations", "error", "event", "spec",
+                 "size", "_primaries", "_waiters")
 
     def __init__(self):
         self.state = "pending"        # pending | ready | error | lost
         self.inline: Optional[bytes] = None
-        self.locations: Set[Address] = set()
+        self._locations: Optional[Set[Address]] = None
         # locations written at produce/put time; pinned on their nodes,
         # never pruned on an unverified claim (secondaries are evictable
         # and get dropped when a pull misses)
-        self.primaries: Set[Address] = set()
+        self._primaries: Optional[Set[Address]] = None
         self.error = None             # SerializedException
-        self.event = threading.Event()
+        self.event = _LazyEvent()
         self.spec: Optional[TaskSpec] = None   # lineage for reconstruction
         self.size = 0                 # stored bytes (locality scheduling)
         # completion callbacks (ref: wait_manager.h WaitRequest — waits
         # are notified, never polled). Persistent: they survive an
         # event.clear() on lineage reconstruction and fire again at the
         # next completion; registrants remove them when done.
-        self.waiters: List[Any] = []
+        self._waiters: Optional[List[Any]] = None
+
+    @property
+    def locations(self) -> Set[Address]:
+        s = self._locations
+        if s is None:
+            s = self._locations = set()
+        return s
+
+    @locations.setter
+    def locations(self, v: Set[Address]):
+        self._locations = v
+
+    @property
+    def primaries(self) -> Set[Address]:
+        s = self._primaries
+        if s is None:
+            s = self._primaries = set()
+        return s
+
+    @primaries.setter
+    def primaries(self, v: Set[Address]):
+        self._primaries = v
+
+    @property
+    def waiters(self) -> List[Any]:
+        w = self._waiters
+        if w is None:
+            w = self._waiters = []
+        return w
+
+    @waiters.setter
+    def waiters(self, v: List[Any]):
+        self._waiters = v
 
 
 class _LeasedWorker:
@@ -266,6 +352,11 @@ class Runtime:
         self._class_parked: Dict[Tuple, int] = defaultdict(int)
         self._class_work: Dict[Tuple, asyncio.Event] = {}
         self._inflight: Dict[TaskID, _PendingTask] = {}
+        # interned per-submit defaults: a deep queue must not allocate a
+        # fresh ResourceSet + SchedulingStrategy per task (owner-side
+        # nothing mutates them; the wire pickles copies)
+        self._default_resources = ResourceSet({"CPU": 1.0})
+        self._default_scheduling = SchedulingStrategy()
         # cancellation state: executing task -> worker addr (set around
         # the push), and ids whose cancel was requested (suppresses the
         # crash-retry path when force-cancel kills the worker)
@@ -637,8 +728,8 @@ class Runtime:
         self._pinned.pop(oid, None)
         with self._dir_lock:
             e = self.directory.pop(oid, None)
-        if e is not None and e.locations:
-            for addr in e.locations:
+        if e is not None and e._locations:
+            for addr in e._locations:
                 self._spawn(self._delete_remote(addr, [oid]))
 
     async def _delete_remote(self, addr: Address, oids: List[ObjectID]):
@@ -819,7 +910,7 @@ class Runtime:
         # value lives in some node store (snapshot under the lock:
         # puller registrations mutate the set concurrently)
         with self._dir_lock:
-            locs = list(e.locations)
+            locs = list(e._locations or ())
         val = self._fetch_from_locations(oid, locs, owner=self.address)
         if val is _MISSING:
             return self._try_reconstruct(ref, deadline, _depth)
@@ -1049,7 +1140,7 @@ class Runtime:
         callbacks may re-enter runtime methods)."""
         e.event.set()
         with self._dir_lock:
-            waiters = list(e.waiters)
+            waiters = list(e._waiters or ())
         for cb in waiters:
             try:
                 cb()
@@ -1259,10 +1350,10 @@ class Runtime:
         spec = TaskSpec(
             task_id=task_id, name=name or getattr(fn, "__name__", "task"),
             func_id=fid, args=spec_args, num_returns=num_returns,
-            resources=resources or ResourceSet({"CPU": 1.0}),
+            resources=resources or self._default_resources,
             owner=self.address, job_id=self.job_id, max_retries=mr,
             retry_exceptions=retry_exceptions,
-            scheduling=scheduling or SchedulingStrategy(),
+            scheduling=scheduling or self._default_scheduling,
             runtime_env=self.resolve_runtime_env(runtime_env),
             trace_ctx=self._trace_ctx(),
             generator_backpressure=generator_backpressure,
@@ -1477,7 +1568,7 @@ class Runtime:
                 e = self.directory.get(oid)
                 if e is None or e.state != "ready" or e.inline is not None:
                     continue
-                locs = list(e.locations)  # snapshot: mutated by add_location
+                locs = list(e._locations or ())  # snapshot: mutated by add_location
                 size = e.size
             for loc in locs:
                 loc = tuple(loc)
@@ -1702,6 +1793,19 @@ class Runtime:
         self._inflight.pop(spec.task_id, None)
         arg_ids = [p[0] for (k, p) in spec.args if k == "ref"]
         self.refs.on_task_done(arg_ids)
+        if (app_error is None and not spec.is_streaming and result.returns
+                and all(k == "inline" for k, _ in result.returns)):
+            # Every return landed INLINE, owner-side: the values live in
+            # this process and can never be lost, so the spec serves no
+            # lineage purpose — drop it. Deep queues retain ~KB of spec
+            # per completed task otherwise (1M-task run: multi-GB driver
+            # RSS; ref: reference_count.h:59 pins lineage only while an
+            # object could need reconstruction).
+            with self._dir_lock:
+                for rid in spec.return_ids():
+                    ent = self.directory.get(rid)
+                    if ent is not None:
+                        ent.spec = None
 
     def _fail_task_returns(self, spec: TaskSpec, exc: BaseException):
         # System errors re-raise as themselves at the caller, not TaskError.
@@ -2317,7 +2421,7 @@ class Runtime:
                         if a not in alive_addrs:
                             e.locations.discard(a)
                             e.primaries.discard(a)
-        if e.locations or e.inline is not None \
+        if e._locations or e.inline is not None \
                 or self.memory_store.get_if_exists(oid) is not _MISSING:
             return {"status": "has_copies"}
         if e.spec is None:
