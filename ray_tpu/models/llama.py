@@ -168,6 +168,69 @@ def num_params(cfg: LlamaConfig) -> int:
 # --- building blocks --------------------------------------------------------
 
 
+def quantize_params_int8(params) -> Dict[str, Any]:
+    """Weight-only per-channel int8 quantization for SERVING (inference;
+    int8 is non-differentiable — training paths reject it implicitly).
+    Matmul weights (embed, lm_head, per-layer projections) become
+    {"q8": int8, "s8": per-output-channel bf16 scale}; norms stay float.
+    Forward paths dequantize ONE layer at a time inside the scan
+    (dequant_layer), so HBM at rest holds int8 — llama-7B weights drop
+    13.5 GB -> ~6.8 GB, fitting a 16 GB v5e chip with a KV page pool
+    (ref: BASELINE.md target 4; the reference's serve scale proofs use
+    multi-GPU sharding instead, release/alpa_tests/inference_opt_30b.py)."""
+    import jax
+
+    def quant(w, keep_first: bool):
+        a = jnp.asarray(w)
+        if a.ndim < 2 or not jnp.issubdtype(a.dtype, jnp.floating):
+            return w
+        axes = tuple(range(1 if keep_first else 0, a.ndim - 1))
+        f = a.astype(jnp.float32)
+        s = jnp.max(jnp.abs(f), axis=axes, keepdims=True) / 127.0
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(f / s), -127, 127).astype(jnp.int8)
+        return {"q8": q, "s8": s.astype(jnp.bfloat16)}
+
+    out = {}
+    for k, v in params.items():
+        if k == "layers":
+            out[k] = {kk: (vv if kk.endswith("norm")
+                           else quant(vv, keep_first=True))
+                      for kk, vv in v.items()}
+        elif k in ("embed", "lm_head"):
+            out[k] = quant(v, keep_first=False)
+        else:
+            out[k] = v
+    return out
+
+
+def _dq(w, dt):
+    """Dequantize one weight (no-op cast for plain arrays)."""
+    if isinstance(w, dict) and "q8" in w:
+        return w["q8"].astype(dt) * w["s8"].astype(dt)
+    return w.astype(dt)
+
+
+def _embed(params, tokens, dt):
+    """Embedding lookup; for int8 tables gather the rows FIRST and
+    dequantize only them — O(tokens x D), never the whole [V, D] table
+    (a per-decode-step 262 MB bf16 transient at 7B otherwise)."""
+    w = params["embed"]
+    if isinstance(w, dict) and "q8" in w:
+        return w["q8"][tokens].astype(dt) * w["s8"].astype(dt)
+    return w.astype(dt)[tokens]
+
+
+def dequant_layer(lp, dt):
+    """Materialize ONE layer's bf16 weights from an int8-quantized layer
+    dict inside a scan body — transient VMEM/HBM per layer instead of the
+    full model (the at-rest copy stays int8)."""
+    if not any(isinstance(v, dict) and "q8" in v for v in lp.values()):
+        return lp
+    return {k: (_dq(v, dt) if isinstance(v, dict) and "q8" in v else v)
+            for k, v in lp.items()}
+
+
 def _checkpoint(body, cfg: "LlamaConfig"):
     if cfg.remat_policy == "dots":
         return jax.checkpoint(
@@ -259,6 +322,7 @@ def _layer(x, lp, cfg: LlamaConfig, cos, sin, cache=None, collect_kv=False):
     B, S, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
+    lp = dequant_layer(lp, dt)
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     if cfg.fused_matmuls:
@@ -334,7 +398,7 @@ def forward(params, tokens, cfg: LlamaConfig, pos_offset=0, mesh=None,
     dt = cfg.dtype
     B, S = tokens.shape
     con = _act_constraint(mesh, rules)
-    x = con(params["embed"].astype(dt)[tokens])
+    x = con(_embed(params, tokens, dt))
     if isinstance(pos_offset, int) and pos_offset == 0:
         cos, sin = _rope_tables(cfg.rope_theta, S, cfg.head_dim)
     else:
@@ -356,7 +420,7 @@ def forward(params, tokens, cfg: LlamaConfig, pos_offset=0, mesh=None,
             lp = jax.tree.map(lambda a: a[i], params["layers"])
             x, _ = body(x, lp)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(dt)
+    logits = x @ _dq(params["lm_head"], dt)
     return logits.astype(jnp.float32) if cfg.f32_logits else logits
 
 
@@ -396,7 +460,7 @@ def forward_pp(params, tokens, cfg: LlamaConfig, mesh, num_microbatches=None):
     M = num_microbatches or max(2 * pp, 1)
     dt = cfg.dtype
     B, S = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    x = _embed(params, tokens, dt)
     cos, sin = _rope_tables(cfg.rope_theta, S, cfg.head_dim)
 
     def stage_fn(stage_layers, x):
@@ -413,7 +477,7 @@ def forward_pp(params, tokens, cfg: LlamaConfig, mesh, num_microbatches=None):
     trunk = pipeline_trunk(stage_fn, mesh, M, schedule=cfg.pp_schedule)
     x = trunk(stacked, x)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(dt)
+    logits = x @ _dq(params["lm_head"], dt)
     return logits.astype(jnp.float32) if cfg.f32_logits else logits
 
 
@@ -482,7 +546,7 @@ def prefill(params, tokens, lengths, cfg: LlamaConfig):
     tokens overwrite pad slots)."""
     dt = cfg.dtype
     B, P = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    x = _embed(params, tokens, dt)
     cos, sin = _rope_tables(cfg.rope_theta, P, cfg.head_dim)
 
     def body(x, lp):
@@ -494,7 +558,7 @@ def prefill(params, tokens, lengths, cfg: LlamaConfig):
     # logits at each row's final REAL position
     idx = jnp.clip(lengths - 1, 0, P - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
-    logits = last @ params["lm_head"].astype(dt)
+    logits = last @ _dq(params["lm_head"], dt)
     return logits.astype(jnp.float32), ks, vs
 
 
@@ -523,7 +587,7 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
         return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
                                axis=-1).astype(x.dtype)
 
-    x = params["embed"].astype(dt)[tokens]                # [B, 1, D]
+    x = _embed(params, tokens, dt)                # [B, 1, D]
     S = cache.k.shape[2]
     kpos = jnp.arange(S)[None, :]                         # [1, S]
     attn_mask = (kpos <= pos[:, None]) & (active[:, None] > 0)  # [B, S]
@@ -534,6 +598,7 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
 
     def body(x, inp):
         lp, ck, cv = inp                                   # ck: [B, S, KV, HD]
+        lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope1((h @ lp["wq"].astype(dt)).reshape(B, 1, H, HD))
         k = rope1((h @ lp["wk"].astype(dt)).reshape(B, 1, KV, HD))
@@ -570,7 +635,7 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
 
     x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x[:, 0] @ _dq(params["lm_head"], dt)).astype(jnp.float32)
     new_len = cache.length + active
     return logits, KVCache(nk, nv, new_len)
 
@@ -626,10 +691,11 @@ def decode_step_paged(params, tokens, k_pools, v_pools, page_table,
     offset = pos % ps
     attn_len = jnp.where(active > 0, pos + 1, 0)
 
-    x = params["embed"].astype(dt)[tokens]                 # [S, 1, D]
+    x = _embed(params, tokens, dt)                 # [S, 1, D]
 
     def body(x, inp):
         lp, kp, vp = inp                                   # kp [KV,NP,ps,HD]
+        lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope1((h @ lp["wq"].astype(dt)).reshape(S, 1, H, HD))
         k = rope1((h @ lp["wk"].astype(dt)).reshape(S, 1, KV, HD))
@@ -652,7 +718,7 @@ def decode_step_paged(params, tokens, k_pools, v_pools, page_table,
     x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], k_pools,
                                          v_pools))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (x[:, 0] @ _dq(params["lm_head"], dt)).astype(jnp.float32)
     return logits, nk, nv, lengths + active
 
 
@@ -707,10 +773,11 @@ def prefill_paged_tail(params, tokens, tail_len, prefix_len, page_table,
     causal = kv_pos[:, None, :] <= qpos[:, :, None]              # [B, T, S_view]
     mask = base_mask[:, None, :] & causal                        # [B, T, S_view]
 
-    x = params["embed"].astype(dt)[tokens]                       # [B, T, D]
+    x = _embed(params, tokens, dt)                       # [B, T, D]
 
     def body(x, inp):
         lp, kp, vp = inp                              # kp [KV, NP, ps, HD]
+        lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope((h @ lp["wq"].astype(dt)).reshape(B, T, H, HD))
         k = rope((h @ lp["wk"].astype(dt)).reshape(B, T, KV, HD))
@@ -748,7 +815,7 @@ def prefill_paged_tail(params, tokens, tail_len, prefix_len, page_table,
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     idx = jnp.clip(tail_len - 1, 0, T - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
-    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (last @ _dq(params["lm_head"], dt)).astype(jnp.float32)
     return logits, nk, nv
 
 
@@ -797,10 +864,11 @@ def prefill_tail_contiguous(params, tokens, tail_len, prefix_len,
         mask = mask & (qpos[:, :, None] - kv_pos[:, None, :]
                        < cfg.sliding_window)
 
-    x = params["embed"].astype(dt)[tokens]                       # [B, T, D]
+    x = _embed(params, tokens, dt)                       # [B, T, D]
 
     def body(x, inp):
         lp, ck, cv = inp                          # ck: [Bslots, S, KV, HD]
+        lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope((h @ lp["wq"].astype(dt)).reshape(B, T, H, HD))
         k = rope((h @ lp["wk"].astype(dt)).reshape(B, T, KV, HD))
@@ -837,7 +905,7 @@ def prefill_tail_contiguous(params, tokens, tail_len, prefix_len,
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     idx = jnp.clip(tail_len - 1, 0, T - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
-    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    logits = (last @ _dq(params["lm_head"], dt)).astype(jnp.float32)
     old_len = cache.length[slot_ids]
     new_len = jnp.where(tail_len > 0,
                         (prefix_len + tail_len).astype(old_len.dtype),
@@ -878,7 +946,7 @@ def forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig,
     logits [B, vocab] and the updated cache."""
     dt = cfg.dtype
     B, S = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    x = _embed(params, tokens, dt)
     cos_full, sin_full = _rope_tables(cfg.rope_theta, cfg.max_seq_len,
                                      cfg.head_dim)
     cos = jax.lax.dynamic_slice_in_dim(cos_full, offset, S, axis=0)
@@ -891,5 +959,5 @@ def forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig,
 
     x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x[:, -1, :] @ params["lm_head"].astype(dt)
+    logits = x[:, -1, :] @ _dq(params["lm_head"], dt)
     return logits.astype(jnp.float32), KVCache(nk, nv, cache.length + S)

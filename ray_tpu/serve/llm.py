@@ -65,7 +65,8 @@ class LLMEngine:
                  max_queue_depth: Optional[int] = None,
                  prefix_caching: bool = True,
                  prefix_cache_max_tail: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 quantize: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -97,6 +98,13 @@ class LLMEngine:
             from ray_tpu.parallel.sharding import shard_params
 
             params = shard_params(mesh, params, llama.param_specs(cfg), rules)
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(f"quantize must be 'int8', got {quantize!r}")
+            # weight-only int8: HBM at rest halves vs bf16 (7B: ~6.8 GB),
+            # layers dequantize transiently inside each scan body
+            params = llama.quantize_params_int8(params)
+        self.quantize = quantize
         self.params = params
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"kv_layout must be 'contiguous' or 'paged', "

@@ -55,6 +55,9 @@ def main():
     ap.add_argument("--num-pages", type=int, default=None)
     ap.add_argument("--max-slots", type=int, default=None)
     ap.add_argument("--max-queue-depth", type=int, default=None)
+    ap.add_argument("--quantize", choices=["none", "int8"], default="none",
+                    help="weight-only int8: at-rest HBM halves (7B fits "
+                    "one 16 GB v5e chip), layers dequantize in-scan")
     ap.add_argument("--prefix-caching", choices=["on", "off"],
                     default="on",
                     help="paged-only: every request here shares one "
@@ -71,6 +74,8 @@ def main():
         kw.update(kv_layout="paged", page_size=args.page_size,
                   num_pages=args.num_pages,
                   prefix_caching=args.prefix_caching == "on")
+    if args.quantize != "none":
+        kw["quantize"] = args.quantize
     server = LLMServer(preset=args.preset, max_slots=max_slots,
                        decode_block=args.decode_block, **kw)
     rtt = measure_tunnel_rtt()
@@ -186,6 +191,7 @@ def main():
         "tunnel_rtt_ms": round(rtt * 1e3, 2),
         "engine_prefill_ms": round(engine_prefill_s * 1e3, 1),
         "kv_layout": args.kv_layout,
+        "quantize": args.quantize,
         "prefix_caching": (args.prefix_caching == "on"
                            if args.kv_layout == "paged" else None),
         "max_slots": max_slots,
