@@ -22,12 +22,14 @@ separate trainer classes.
     result = JaxTrainer(train_loop, scaling_config=ScalingConfig(...)).fit()
 """
 
-from ray_tpu.train.backend import (Backend, JaxBackend, TorchBackend,
-                                   prepare_data_loader, prepare_model)
+from ray_tpu.train.backend import (Backend, JaxBackend, TensorflowBackend,
+                                   TorchBackend, prepare_data_loader,
+                                   prepare_model)
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.checkpoint import Checkpoint
-from ray_tpu.train.trainer import JaxTrainer, Result, TorchTrainer
+from ray_tpu.train.trainer import (JaxTrainer, Result, TensorflowTrainer,
+                                   TorchTrainer)
 from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
                                      Predictor, TorchPredictor,
                                      TransformersPredictor)
@@ -39,12 +41,13 @@ from ray_tpu.train.sklearn import (LightGBMTrainer, SklearnTrainer,
 from ray_tpu.train import session
 
 __all__ = [
-    "JaxTrainer", "TorchTrainer", "Result", "ScalingConfig", "RunConfig",
+    "JaxTrainer", "TorchTrainer", "TensorflowTrainer", "Result",
+    "ScalingConfig", "RunConfig",
     "FailureConfig", "CheckpointConfig", "Checkpoint", "session",
     "Predictor", "JaxPredictor", "BatchPredictor", "TorchPredictor",
     "TransformersPredictor",
-    "Backend", "JaxBackend", "TorchBackend", "prepare_model",
-    "prepare_data_loader",
+    "Backend", "JaxBackend", "TensorflowBackend", "TorchBackend",
+    "prepare_model", "prepare_data_loader",
     "SklearnTrainer", "XGBoostTrainer", "LightGBMTrainer",
     "TransformersTrainer", "AccelerateTrainer", "AccelerateBackend",
     "shard_to_list",

@@ -48,6 +48,47 @@ class JaxBackend(Backend):
                                        process_id=rank)
 
 
+class TensorflowBackend(Backend):
+    """TF_CONFIG rendezvous for tf.distribute.MultiWorkerMirroredStrategy
+    (ref: train/tensorflow/config.py:21,40 _setup_tensorflow_environment):
+    the trainer collects EVERY worker's host:port (TF needs the full
+    cluster spec, not just a coordinator) and each worker exports
+    TF_CONFIG before tensorflow builds its cluster resolver. The user
+    loop constructs MultiWorkerMirroredStrategy itself, exactly like the
+    reference's TensorflowTrainer loops."""
+
+    needs_coordinator = True
+    #: trainer fills worker_addresses (one host:port per rank) before
+    #: pickling this backend out to the workers
+    needs_worker_addresses = True
+
+    def __init__(self):
+        self.worker_addresses = None
+
+    def on_worker_setup(self, rank, world_size, coordinator):
+        import json
+        import os
+
+        addrs = self.worker_addresses
+        if addrs is None:
+            if world_size > 1:
+                # a one-entry cluster spec with task index >= 1 would
+                # make MWMS hang/raise cryptically — fail loudly instead
+                raise RuntimeError(
+                    "TensorflowBackend.worker_addresses not populated; "
+                    "the trainer must gather one host:port per rank "
+                    "before worker setup")
+            addrs = [coordinator] if coordinator else []
+        os.environ["TF_CONFIG"] = json.dumps({
+            "cluster": {"worker": addrs},
+            "task": {"type": "worker", "index": rank}})
+
+    def on_worker_shutdown(self):
+        import os
+
+        os.environ.pop("TF_CONFIG", None)
+
+
 class TorchBackend(Backend):
     """torch.distributed gloo process group (ref: train/torch/config.py:69
     _setup_torch_process_group; nccl is GPU-only — on this stack the

@@ -17,7 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 import ray_tpu
 from ray_tpu.core.status import ActorDiedError, ActorUnavailableError, TaskError
 from ray_tpu.train import storage
-from ray_tpu.train.backend import TorchBackend
+from ray_tpu.train.backend import TensorflowBackend, TorchBackend
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig, ScalingConfig
 from ray_tpu.train.worker_group import WorkerGroup
@@ -102,8 +102,18 @@ class JaxTrainer:
                 self.datasets, self.scaling.num_workers)
             coordinator = None
             if self.scaling.num_workers > 1 or self.backend.needs_coordinator:
-                info = ray_tpu.get(group.workers[0].host_info.remote())
-                coordinator = f"{info['hostname']}:{info['free_port']}"
+                if getattr(self.backend, "needs_worker_addresses", False):
+                    # TF_CONFIG-style backends need the FULL cluster spec:
+                    # one reserved host:port per rank (each worker holds
+                    # its reservation until its own setup() releases it)
+                    infos = ray_tpu.get(
+                        [w.host_info.remote() for w in group.workers])
+                    self.backend.worker_addresses = [
+                        f"{i['hostname']}:{i['free_port']}" for i in infos]
+                    coordinator = self.backend.worker_addresses[0]
+                else:
+                    info = ray_tpu.get(group.workers[0].host_info.remote())
+                    coordinator = f"{info['hostname']}:{info['free_port']}"
             setup_refs = [
                 w.setup.remote(self.config, run_dir, self.scaling, checkpoint,
                                shards[i], coordinator,
@@ -185,6 +195,15 @@ class TorchTrainer(JaxTrainer):
     ray_tpu.train.prepare_model unchanged."""
 
     backend_cls = TorchBackend
+
+
+class TensorflowTrainer(JaxTrainer):
+    """Reference-parity TF trainer (ref: train/tensorflow/
+    tensorflow_trainer.py + config.py:21,40): same orchestration,
+    TF_CONFIG rendezvous exported per worker; user loops build
+    tf.distribute.MultiWorkerMirroredStrategy unchanged."""
+
+    backend_cls = TensorflowBackend
 
 
 def _latest_checkpoint(run_dir: str) -> Optional[Checkpoint]:
