@@ -28,6 +28,13 @@ class Config:
     # Native zero-staging transfer plane (native/xfer.cc); off -> always
     # use the portable chunk-RPC pull path.
     native_transfer_enabled: bool = True
+    # Max concurrent outbound serves PER OBJECT per node (0 = unlimited;
+    # pulls of distinct objects always multiplex freely). Over-cap
+    # pullers get "busy" and retry against whichever holders have
+    # registered copies by then — a fan-in broadcast of one hot object
+    # cascades through peers instead of serializing behind one source
+    # (ref: pull_manager.h:52 pulls spread across every holder).
+    object_serve_concurrency: int = 2
     # kCreating store entries older than this are orphans of a dead
     # producer and get reaped. The transfer plane heartbeats the entry
     # per read() batch while bytes flow, and each read() is bounded by

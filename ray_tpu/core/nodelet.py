@@ -141,6 +141,8 @@ class Nodelet:
         # back to the chunk RPC path transparently.
         self.xfer_port = self.store.xfer_serve_start(host) \
             if self.cfg.native_transfer_enabled else -1
+        if self.xfer_port > 0:
+            self.store.xfer_set_serve_cap(self.cfg.object_serve_concurrency)
         self.server.host, self.server.port = host, port
         addr = await self.server.start()
         info = NodeInfo(node_id=self.node_id, nodelet_addr=addr,
@@ -1040,13 +1042,15 @@ class Nodelet:
         self._xfer_ports[key] = (port, now + ttl)
         return port
 
-    async def _pull_native(self, oid: ObjectID, source: Address) -> bool:
-        """Try the zero-staging native plane first. Returns True when the
-        object is sealed locally; False = fall back to chunk RPC."""
+    async def _pull_native(self, oid: ObjectID, source: Address) -> str:
+        """Try the zero-staging native plane first. Returns "ok" (sealed
+        locally), "busy" (source at its serve cap — the puller should
+        retry, ideally at another holder), or "fallback" (use chunk
+        RPC)."""
         key = tuple(source)
         port = await self._xfer_port_for(key)
         if port <= 0:
-            return False
+            return "fallback"
         host = source[0]
         rc, total = await asyncio.to_thread(self.store.xfer_fetch, host,
                                             port, oid)
@@ -1067,26 +1071,30 @@ class Nodelet:
             deadline = time.time() + 150.0
             while time.time() < deadline:
                 if self.store.contains(oid):
-                    return True
+                    return "ok"
                 st = self.store.state(oid)
                 if st == 0:   # racer aborted; retry once natively
                     rc2, _ = await asyncio.to_thread(self.store.xfer_fetch,
                                                      host, port, oid)
                     if rc2 == 0:
                         self._native_pulls += 1
-                        return True
+                        return "ok"
+                    if rc2 == 6:
+                        return "busy"
                     if rc2 != 5:
-                        return False
+                        return "fallback"
                 await asyncio.sleep(0.05)
-            return False
+            return "fallback"
+        if rc == 6:
+            return "busy"
         if rc == 2:
             # io error: peer may have restarted on a new port — requery
             self._xfer_ports.pop(key, None)
-            return False
+            return "fallback"
         if rc == 0:
             self._native_pulls += 1
-            return True
-        return False
+            return "ok"
+        return "fallback"
 
     async def rpc_pull_object(self, oid: ObjectID, source: Address) -> dict:
         """Pull a remote object into the local store: native zero-staging
@@ -1098,8 +1106,14 @@ class Nodelet:
             return {"ok": True}
         if tuple(source) == (self.server.host, self.server.port):
             return {"ok": False, "error": "object not at source"}
-        if await self._pull_native(oid, source):
+        native = await self._pull_native(oid, source)
+        if native == "ok":
             return {"ok": True}
+        if native == "busy":
+            # do NOT fall through to chunk RPC: that would route the
+            # same bytes through the same saturated source, just slower.
+            # The caller retries — against a peer once one registers.
+            return {"ok": False, "busy": True, "error": "source busy"}
         src = self.pool.get(tuple(source))
         chunk = self.cfg.object_transfer_chunk_bytes
         try:
@@ -1168,6 +1182,8 @@ class Nodelet:
                               if self.spill is not None else 0),
             "restored_objects": self._restored,
             "native_pulls": self._native_pulls,
+            "serve_busy_rejections": (self.store.xfer_busy_rejections()
+                                      if self.xfer_port > 0 else 0),
             "xfer_port": self.xfer_port,
             "pending_leases": len(self.pending),
             "oom_kills": self.memory_monitor.kills,

@@ -89,6 +89,10 @@ class _Lib:
                 lib.ts_xfer_fetch.argtypes = [
                     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
                     ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+                lib.ts_xfer_set_serve_cap.restype = None
+                lib.ts_xfer_set_serve_cap.argtypes = [ctypes.c_int]
+                lib.ts_xfer_busy_rejections.restype = ctypes.c_uint64
+                lib.ts_xfer_busy_rejections.argtypes = []
                 cls._lib = lib
             return cls._lib
 
@@ -280,12 +284,25 @@ class SharedMemoryStore:
             self._xfer_undrained = True
         return leftover
 
+    def xfer_set_serve_cap(self, cap: int) -> None:
+        """Cap concurrent outbound serves PER OBJECT from this process's
+        transfer server (0 = unlimited; distinct objects multiplex
+        freely). Over-cap pullers get a busy reply and retry — against a
+        peer holder once one registers (the broadcast distribution tree,
+        ref: pull_manager.h:52 holder fan-out)."""
+        self._lib.ts_xfer_set_serve_cap(int(cap))
+
+    def xfer_busy_rejections(self) -> int:
+        """Count of pulls this server answered 'busy' (serve-cap hits)."""
+        return int(self._lib.ts_xfer_busy_rejections())
+
     def xfer_fetch(self, host: str, port: int,
                    oid: ObjectID) -> "tuple[int, int]":
         """Blocking fetch of one remote object straight into this store.
         Returns (rc, total_bytes): rc 0=ok 1=absent-at-source 2=io-error
-        3=alloc-failed 4=protocol 5=already-local/arriving. total is the
-        source-reported size (0 when unknown) — on rc=3 it tells the
+        3=alloc-failed 4=protocol 5=already-local/arriving 6=source-busy
+        (at its serve cap — retry, ideally at another holder). total is
+        the source-reported size (0 when unknown) — on rc=3 it tells the
         caller exactly how much space to free."""
         total = ctypes.c_uint64(0)
         rc = int(self._lib.ts_xfer_fetch(

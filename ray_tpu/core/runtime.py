@@ -28,7 +28,7 @@ import threading
 import time
 import traceback
 import weakref
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from concurrent.futures import Future as SyncFuture
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -52,6 +52,10 @@ from ray_tpu.core.status import (ActorDiedError, ActorUnavailableError,
 from ray_tpu.runtime_env import process_env as _process_env
 
 logger = logging.getLogger("ray_tpu.runtime")
+
+#: _fetch_from_locations result: every reachable copy answered "busy"
+#: (source serve cap) — retry with refreshed locations; NOT lost.
+_BUSY = object()
 
 _runtime_lock = threading.Lock()
 _global_runtime: Optional["Runtime"] = None
@@ -362,6 +366,13 @@ class Runtime:
         # crash-retry path when force-cancel kills the worker)
         self._task_worker: Dict[TaskID, Address] = {}
         self._cancel_requested: Set[TaskID] = set()
+        # pull bookkeeping: which holder served each remote pull
+        # (observability — the broadcast bench/tests assert peer-sourcing
+        # with it; bounded, oldest evicted), and sources that recently
+        # answered "busy" (sorted last on retry so fresh holders are
+        # tried first; bounded by cluster size)
+        self._pull_sources: "OrderedDict[ObjectID, Address]" = OrderedDict()
+        self._busy_sources: Dict[Address, float] = {}
         # streaming-generator tasks owned here (ref: task_manager.h:143-171)
         self._streams: Dict[TaskID, _StreamState] = {}
         self._stream_lock = threading.Lock()
@@ -909,9 +920,21 @@ class Runtime:
             return serialization.unpack(e.inline)
         # value lives in some node store (snapshot under the lock:
         # puller registrations mutate the set concurrently)
-        with self._dir_lock:
-            locs = list(e._locations or ())
-        val = self._fetch_from_locations(oid, locs, owner=self.address)
+        busy_rounds = 0
+        while True:
+            with self._dir_lock:
+                locs = list(e._locations or ())
+            val = self._fetch_from_locations(oid, locs, owner=self.address)
+            if val is not _BUSY:
+                break
+            # every holder is at its serve cap: back off (escalating, so
+            # a wedged source is not hammered with 20 connects/s) until a
+            # slot frees or a new copy registers. _remaining raises
+            # GetTimeoutError at the get deadline.
+            rem = self._remaining(deadline)
+            delay = min(0.5, 0.05 * (1 << min(busy_rounds, 4)))
+            busy_rounds += 1
+            time.sleep(min(delay, rem) if rem is not None else delay)
         if val is _MISSING:
             return self._try_reconstruct(ref, deadline, _depth)
         return val
@@ -931,6 +954,7 @@ class Runtime:
             if val is not _MISSING:
                 return val
         self._ensure_blocked()
+        busy_rounds = 0
         while True:
             rem = self._remaining(deadline)
             step = min(rem, 5.0) if rem is not None else 5.0
@@ -951,6 +975,15 @@ class Runtime:
                 return serialization.unpack(r["inline"])
             locs = [tuple(a) for a in r["locations"]]
             val = self._fetch_from_locations(oid, locs, owner=owner)
+            if val is _BUSY:
+                # all holders at their serve cap: re-poll the owner —
+                # the refreshed location set includes any copy a winning
+                # puller registered meanwhile (the distribution tree).
+                # Escalating backoff; the loop-top _remaining raises at
+                # the get deadline.
+                time.sleep(min(0.5, 0.05 * (1 << min(busy_rounds, 4))))
+                busy_rounds += 1
+                continue
             if val is _MISSING:
                 # Every advertised copy is gone (their nodes died). Tell
                 # the owner so it prunes the locations and re-executes
@@ -984,10 +1017,17 @@ class Runtime:
         # already holds a copy instead of hammering the producer — with
         # copy registration below, a broadcast forms an emergent
         # distribution tree (ref: object manager location updates let
-        # pulled copies serve later pulls).
+        # pulled copies serve later pulls). Sources that just answered
+        # "busy" (serve cap, nodelet rpc_pull_object) sort last, so a
+        # retry reaches fresh holders FIRST — that is what lets the tree
+        # form within a single concurrent fan-in instead of only across
+        # sequential waves.
         local = [a for a in locations if tuple(a) == self.nodelet_addr]
         remote = [a for a in locations if tuple(a) != self.nodelet_addr]
         random.shuffle(remote)
+        now = time.time()
+        remote.sort(key=lambda a: self._busy_sources.get(tuple(a), 0.0) > now)
+        busy_seen = False
         for loc in local + remote:
             try:
                 r = self._run(self.pool.get(self.nodelet_addr).call(
@@ -1000,7 +1040,13 @@ class Runtime:
                 if v is not _MISSING:
                     if tuple(loc) != self.nodelet_addr:
                         self._register_copy(oid, owner)
+                        self._pull_sources[oid] = tuple(loc)
+                        while len(self._pull_sources) > 1024:
+                            self._pull_sources.popitem(last=False)
                     return v
+            elif r.get("busy"):
+                busy_seen = True
+                self._busy_sources[tuple(loc)] = now + 3.0
             elif tuple(loc) != self.nodelet_addr \
                     and "not at source" in str(r.get("error", "")):
                 # definitively evicted there (NOT a transient source
@@ -1010,6 +1056,11 @@ class Runtime:
                 self._notify_drop_location(oid, tuple(loc), owner)
         # one more local attempt (producer may be co-located)
         v = self._read_local(oid)
+        if v is _MISSING and busy_seen:
+            # every reachable copy is at its serve cap: signal "retry
+            # with refreshed locations", NOT "lost" — a busy source must
+            # never trigger recovery/reconstruction
+            return _BUSY
         return v
 
     def _fire_and_forget(self, to_addr: Address, op: str, **kw):

@@ -41,7 +41,9 @@
 #include <chrono>
 #include <condition_variable>
 #include <mutex>
+#include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 // Public C ABI of the store (objstore.cc, linked into the same .so).
@@ -60,6 +62,12 @@ namespace {
 
 constexpr uint32_t kIdLen = 20;
 constexpr uint64_t kAbsent = ~0ULL;
+// "source saturated" reply: the puller should retry (possibly against a
+// peer that registered a copy in the meantime) instead of queueing here.
+// A broadcast fan-in then cascades through fresh holders rather than
+// serializing every transfer behind one source NIC/core (ref: pull
+// manager fan-out across holders, pull_manager.h:52).
+constexpr uint64_t kBusy = ~0ULL - 1;
 constexpr int kIoTimeoutSec = 120;
 
 struct ServerState {
@@ -70,6 +78,16 @@ struct ServerState {
 };
 
 ServerState g_server;
+
+// Outbound-serve throttle: at most g_serve_cap payloads of THE SAME
+// object in flight (0 = unlimited). Excess requests get kBusy instead of
+// a queue slot. Per-object, not global: a broadcast fan-in of one hot
+// object should cascade through peer holders, but pulls of DISTINCT
+// objects from one node must keep multiplexing freely.
+std::atomic<int> g_serve_cap{0};
+std::atomic<uint64_t> g_busy_rejections{0};
+std::mutex g_serve_mu;
+std::unordered_map<std::string, int> g_active_by_id;
 
 // Connection registry. ts_xfer_serve_stop() MUST NOT return while any
 // sender thread can still touch the shm heap or the Store handle: the
@@ -138,10 +156,35 @@ void handle_conn(int fd, void* store) {
       if (!write_exact(fd, &absent, sizeof(absent))) break;
       continue;
     }
+    int cap = g_serve_cap.load(std::memory_order_relaxed);
+    bool counted = false;
+    if (cap > 0) {
+      std::string idkey(reinterpret_cast<const char*>(id), kIdLen);
+      std::lock_guard<std::mutex> lk(g_serve_mu);
+      int& n = g_active_by_id[idkey];
+      if (n < cap) {
+        ++n;
+        counted = true;
+      }
+    }
+    if (cap > 0 && !counted) {
+      g_busy_rejections.fetch_add(1);
+      ts_release(store, id);
+      uint64_t busy = kBusy;
+      if (!write_exact(fd, &busy, sizeof(busy))) break;
+      continue;
+    }
     const uint8_t* payload =
         reinterpret_cast<const uint8_t*>(ts_seg_base(store)) + off;
     bool ok = write_exact(fd, &size, sizeof(size)) &&
               write_exact(fd, payload, size);
+    if (counted) {
+      std::string idkey(reinterpret_cast<const char*>(id), kIdLen);
+      std::lock_guard<std::mutex> lk(g_serve_mu);
+      auto it = g_active_by_id.find(idkey);
+      if (it != g_active_by_id.end() && --it->second <= 0)
+        g_active_by_id.erase(it);
+    }
     ts_release(store, id);
     if (!ok) break;
   }
@@ -269,7 +312,8 @@ int ts_xfer_serve_stop() {
 // error, 3 = local allocation failed (caller should free space + retry
 // or fall back), 4 = protocol error (local buffer aborted),
 // 5 = already local (sealed, or a racing pull is mid-write — wait, do
-// not free space for it).
+// not free space for it), 6 = source at its serve cap (retry, ideally
+// against another holder).
 int ts_xfer_fetch(void* store, const char* host, int port,
                   const uint8_t* id, uint64_t* total_out) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
@@ -296,6 +340,10 @@ int ts_xfer_fetch(void* store, const char* host, int port,
   if (total == kAbsent) {
     close(fd);
     return 1;
+  }
+  if (total == kBusy) {
+    close(fd);
+    return 6;
   }
   if (total_out) *total_out = total;
   uint64_t off = ts_create_buf(store, id, total);
@@ -343,5 +391,13 @@ int ts_xfer_fetch(void* store, const char* host, int port,
   ts_seal(store, id);
   return 0;
 }
+
+// Concurrent-outbound-serve cap PER OBJECT for this process's transfer
+// server (0 = unlimited). Over-cap requests are answered kBusy.
+void ts_xfer_set_serve_cap(int cap) {
+  g_serve_cap.store(cap < 0 ? 0 : cap);
+}
+
+uint64_t ts_xfer_busy_rejections() { return g_busy_rejections.load(); }
 
 }  // extern "C"
