@@ -538,6 +538,33 @@ def cache_specs(cfg: LlamaConfig):
                    (None,))
 
 
+def _layer_scan_with_kv(body, x, a_all, b_all, layers):
+    """lax.scan over stacked per-layer inputs with two stacked KV
+    buffers ([L, ...]) kept in the CARRY, each layer's slice read and
+    written back in place via dynamic_(index|update_index)_in_dim.
+
+    This is the memory shape every cached forward uses: passing the
+    buffers as scan xs with restacked ys makes XLA materialize a second
+    full-size copy (and the layout-assignment copies that follow), which
+    at 2.7B+ pools/caches is multiple GB of HBM temp — enough that the
+    decode program alone exceeded the 16 GB chip before this form.
+
+    body(x, layer_xs, a_slice, b_slice) -> (x, new_a_slice, new_b_slice)
+    """
+    def wrap(carry, lx):
+        x, a_all, b_all, li = carry
+        a = jax.lax.dynamic_index_in_dim(a_all, li, 0, keepdims=False)
+        b = jax.lax.dynamic_index_in_dim(b_all, li, 0, keepdims=False)
+        x, a, b = body(x, lx, a, b)
+        a_all = jax.lax.dynamic_update_index_in_dim(a_all, a, li, 0)
+        b_all = jax.lax.dynamic_update_index_in_dim(b_all, b, li, 0)
+        return (x, a_all, b_all, li + 1), None
+
+    (x, a_all, b_all, _), _ = jax.lax.scan(
+        wrap, (x, a_all, b_all, jnp.int32(0)), layers)
+    return x, a_all, b_all
+
+
 def prefill(params, tokens, lengths, cfg: LlamaConfig):
     """Batched prefill for the continuous-batching engine. tokens [n, P]
     right-padded; lengths [n] true lengths. Returns (logits_at_last [n, V],
@@ -596,8 +623,7 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
         # keys are visible (cache layout unchanged)
         attn_mask = attn_mask & (pos[:, None] - kpos < cfg.sliding_window)
 
-    def body(x, inp):
-        lp, ck, cv = inp                                   # ck: [B, S, KV, HD]
+    def body(x, lp, ck, cv):
         lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope1((h @ lp["wq"].astype(dt)).reshape(B, 1, H, HD))
@@ -631,9 +657,10 @@ def decode_step(params, tokens, cache: KVCache, cfg: LlamaConfig,
         gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
         up = h @ lp["w_up"].astype(dt)
         x = x + (gate * up) @ lp["w_down"].astype(dt)
-        return x, (upd, vpd)
+        return x, upd, vpd
 
-    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x, nk, nv = _layer_scan_with_kv(body, x, cache.k, cache.v,
+                                    params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ _dq(params["lm_head"], dt)).astype(jnp.float32)
     new_len = cache.length + active
@@ -658,10 +685,11 @@ def decode_step_paged(params, tokens, k_pools, v_pools, page_table,
     tokens [S, 1]; k_pools/v_pools [L, KV, NP, ps, HD]; page_table
     [S, maxP]; lengths [S] = tokens already stored per slot. Returns
     (logits [S, V], new k_pools, new v_pools, new lengths). Rows with
-    active==0 write their k/v into the trash page 0 and keep length.
-    The attention itself is ops/paged_attention.py's Pallas kernel
-    (XLA-gather reference off-TPU)."""
-    from ray_tpu.ops.paged_attention import paged_attention
+    active==0 skip the KV write entirely and keep length (only the
+    kernel's unwritten-window flush may touch the reserved trash page
+    0). Write+attend is ops/paged_attention.py's fused Pallas kernel
+    (XLA scatter+gather reference off-TPU)."""
+    from ray_tpu.ops.paged_attention import paged_decode_attention_inplace
 
     if cfg.sliding_window is not None:
         raise ValueError("paged decode does not support sliding_window")
@@ -684,28 +712,28 @@ def decode_step_paged(params, tokens, k_pools, v_pools, page_table,
         return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
                                axis=-1).astype(x.dtype)
 
-    # physical write target per slot; inactive rows land in trash page 0
-    page_slot = jnp.take_along_axis(page_table,
-                                    (pos // ps)[:, None], axis=1)[:, 0]
-    page_slot = jnp.where(active > 0, page_slot, 0)
-    offset = pos % ps
+    # the fused kernel derives each slot's tip page/offset from attn_len;
+    # inactive rows (attn_len 0) skip the write entirely
     attn_len = jnp.where(active > 0, pos + 1, 0)
 
     x = _embed(params, tokens, dt)                 # [S, 1, D]
 
-    def body(x, inp):
-        lp, kp, vp = inp                                   # kp [KV,NP,ps,HD]
+    # Pools ride the scan CARRY; the new token's k/v write happens INSIDE
+    # the fused Pallas kernel through pool-aliased outputs (see
+    # ops/paged_attention.py paged_decode_attention_inplace). The earlier
+    # forms — pools-as-xs with restacked ys, or an XLA scatter per layer —
+    # each materialized extra full-pool copies (the scatter's KV-minor
+    # layout preference alone cost two +3 GB layout copies at 2.7B, and
+    # the decode program exceeded the 16 GB chip).
+    def body(x, lp, kp, vp):
         lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope1((h @ lp["wq"].astype(dt)).reshape(S, 1, H, HD))
         k = rope1((h @ lp["wk"].astype(dt)).reshape(S, 1, KV, HD))
         v = (h @ lp["wv"].astype(dt)).reshape(S, 1, KV, HD)
-        kp = kp.at[:, page_slot, offset, :].set(
-            k[:, 0].transpose(1, 0, 2).astype(kp.dtype))
-        vp = vp.at[:, page_slot, offset, :].set(
-            v[:, 0].transpose(1, 0, 2).astype(vp.dtype))
-        o = paged_attention(q[:, 0].astype(dt), kp.astype(dt),
-                            vp.astype(dt), page_table, attn_len)
+        o, kp, vp = paged_decode_attention_inplace(
+            q[:, 0].astype(dt), k[:, 0].astype(kp.dtype),
+            v[:, 0].astype(vp.dtype), kp, vp, page_table, attn_len)
         # fully-masked (inactive) rows return garbage — zero them
         o = jnp.where((active > 0)[:, None, None], o, 0.0)
         x = x + o.reshape(S, 1, H * HD) @ lp["wo"].astype(dt)
@@ -713,10 +741,10 @@ def decode_step_paged(params, tokens, k_pools, v_pools, page_table,
         gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
         up = h @ lp["w_up"].astype(dt)
         x = x + (gate * up) @ lp["w_down"].astype(dt)
-        return x, (kp, vp)
+        return x, kp, vp
 
-    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], k_pools,
-                                         v_pools))
+    x, nk, nv = _layer_scan_with_kv(body, x, k_pools, v_pools,
+                                    params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ _dq(params["lm_head"], dt)).astype(jnp.float32)
     return logits, nk, nv, lengths + active
@@ -775,8 +803,7 @@ def prefill_paged_tail(params, tokens, tail_len, prefix_len, page_table,
 
     x = _embed(params, tokens, dt)                       # [B, T, D]
 
-    def body(x, inp):
-        lp, kp, vp = inp                              # kp [KV, NP, ps, HD]
+    def body(x, lp, kp, vp):
         lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope((h @ lp["wq"].astype(dt)).reshape(B, T, H, HD))
@@ -808,10 +835,10 @@ def prefill_paged_tail(params, tokens, tail_len, prefix_len, page_table,
         gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
         up = h @ lp["w_up"].astype(dt)
         x = x + (gate * up) @ lp["w_down"].astype(dt)
-        return x, (kp, vp)
+        return x, kp, vp
 
-    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], k_pools,
-                                         v_pools))
+    x, nk, nv = _layer_scan_with_kv(body, x, k_pools, v_pools,
+                                    params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     idx = jnp.clip(tail_len - 1, 0, T - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
@@ -866,8 +893,7 @@ def prefill_tail_contiguous(params, tokens, tail_len, prefix_len,
 
     x = _embed(params, tokens, dt)                       # [B, T, D]
 
-    def body(x, inp):
-        lp, ck, cv = inp                          # ck: [Bslots, S, KV, HD]
+    def body(x, lp, ck, cv):
         lp = dequant_layer(lp, dt)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = rope((h @ lp["wq"].astype(dt)).reshape(B, T, H, HD))
@@ -898,10 +924,10 @@ def prefill_tail_contiguous(params, tokens, tail_len, prefix_len,
         gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
         up = h @ lp["w_up"].astype(dt)
         x = x + (gate * up) @ lp["w_down"].astype(dt)
-        return x, (ck, cv)
+        return x, ck, cv
 
-    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k,
-                                         cache.v))
+    x, nk, nv = _layer_scan_with_kv(body, x, cache.k, cache.v,
+                                    params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     idx = jnp.clip(tail_len - 1, 0, T - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
@@ -930,12 +956,21 @@ def scatter_prefill_pages(k_pools, v_pools, ks, vs, page_table, slots,
     offs = jnp.broadcast_to(pos % ps, (n, P))
     pages_f = pages.reshape(-1)
     offs_f = offs.reshape(-1)
-    k_f = ks.transpose(0, 3, 1, 2, 4).reshape(L, KV, n * P, HD)
-    v_f = vs.transpose(0, 3, 1, 2, 4).reshape(L, KV, n * P, HD)
-    k_pools = k_pools.at[:, :, pages_f, offs_f, :].set(
-        k_f.astype(k_pools.dtype))
-    v_pools = v_pools.at[:, :, pages_f, offs_f, :].set(
-        v_f.astype(v_pools.dtype))
+
+    # Scatter one LAYER at a time with the pools as scan carry: a
+    # whole-pool scatter forces a full pool-sized layout copy in the
+    # compiled program (+2.7 GB transient at 2.7B; see
+    # _layer_scan_with_kv) — per-layer, the transient is 1/L of that.
+    def body(x, inp, kp, vp):
+        k_l, v_l = inp                                 # [n, P, KV, HD]
+        k_f = k_l.transpose(2, 0, 1, 3).reshape(KV, n * P, HD)
+        v_f = v_l.transpose(2, 0, 1, 3).reshape(KV, n * P, HD)
+        kp = kp.at[:, pages_f, offs_f, :].set(k_f.astype(kp.dtype))
+        vp = vp.at[:, pages_f, offs_f, :].set(v_f.astype(vp.dtype))
+        return x, kp, vp
+
+    _, k_pools, v_pools = _layer_scan_with_kv(
+        body, jnp.int32(0), k_pools, v_pools, (ks, vs))
     return k_pools, v_pools
 
 
@@ -952,12 +987,13 @@ def forward_with_cache(params, tokens, cache: KVCache, cfg: LlamaConfig,
     cos = jax.lax.dynamic_slice_in_dim(cos_full, offset, S, axis=0)
     sin = jax.lax.dynamic_slice_in_dim(sin_full, offset, S, axis=0)
 
-    def body(x, inp):
-        lp, ck, cv = inp
-        y, new_cache = _layer(x, lp, cfg, cos, sin, cache=(ck, cv, offset))
-        return y, new_cache
+    def body(x, lp, ck, cv):
+        y, (nk_l, nv_l) = _layer(x, lp, cfg, cos, sin,
+                                 cache=(ck, cv, offset))
+        return y, nk_l, nv_l
 
-    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x, nk, nv = _layer_scan_with_kv(body, x, cache.k, cache.v,
+                                    params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = x[:, -1, :] @ _dq(params["lm_head"], dt)
     return logits.astype(jnp.float32), KVCache(nk, nv, cache.length + S)

@@ -92,17 +92,44 @@ class LLMEngine:
         self.max_slots = max_slots
         self.eos = eos_token
         self.max_queue_depth = max_queue_depth
+        if quantize is not None and quantize != "int8":
+            raise ValueError(f"quantize must be 'int8', got {quantize!r}")
+        quantized = False
         if params is None:
-            params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+            # Serving holds no optimizer/master weights: init straight in
+            # the compute dtype (bf16 on TPU). f32 masters would DOUBLE
+            # weight HBM — at 2.7B that alone is 10.8 of the chip's
+            # 16 GB and the engine OOMs before its first admit.
+            icfg = cfg.replace(param_dtype=cfg.dtype)
+            cpu_dev = None
+            if quantize == "int8" and mesh is None \
+                    and jax.default_backend() != "cpu":
+                try:
+                    cpu_dev = jax.devices("cpu")[0]
+                except RuntimeError:
+                    cpu_dev = None   # no host backend: quantize on-chip
+            if cpu_dev is not None:
+                # init + quantize on HOST, ship only the int8 tree: doing
+                # both on-chip transiently holds bf16 AND int8 copies
+                # (7B: ~20 GB peak — past the chip) before the bf16 side
+                # is freed
+                with jax.default_device(cpu_dev):
+                    params = llama.init_params(jax.random.PRNGKey(seed),
+                                               icfg)
+                    params = llama.quantize_params_int8(params)
+                params = jax.device_put(params, jax.devices()[0])
+                quantized = True
+            else:
+                params = llama.init_params(jax.random.PRNGKey(seed), icfg)
         if mesh is not None and rules is not None:
             from ray_tpu.parallel.sharding import shard_params
 
             params = shard_params(mesh, params, llama.param_specs(cfg), rules)
-        if quantize is not None:
-            if quantize != "int8":
-                raise ValueError(f"quantize must be 'int8', got {quantize!r}")
+        if quantize == "int8" and not quantized:
             # weight-only int8: HBM at rest halves vs bf16 (7B: ~6.8 GB),
-            # layers dequantize transiently inside each scan body
+            # layers dequantize transiently inside each scan body.
+            # (After sharding: the quantized tree's {"q8","s8"} leaves no
+            # longer match param_specs.)
             params = llama.quantize_params_int8(params)
         self.quantize = quantize
         self.params = params

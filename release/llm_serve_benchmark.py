@@ -83,12 +83,17 @@ def main():
     # Warmup: drive every prefill bucket + decode-block compilation once,
     # so measured TTFT reflects steady-state serving, not XLA compiles
     # (the reference's serve benchmarks likewise exclude cold start).
+    t_warm = time.time()
+    print(f"# warmup: initial batch ({min(4, args.concurrency)} reqs) — "
+          "first prefill+decode compiles", file=sys.stderr, flush=True)
     warm = [server.engine.submit(list(range(2, 2 + args.prompt_len)),
                                  args.max_new_tokens)
             for _ in range(min(4, args.concurrency))]
     server._wake.set()
     for w in warm:
-        w.done_event.wait(timeout=600)
+        w.done_event.wait(timeout=3600)
+    print(f"# warmup: initial batch done in {time.time() - t_warm:.0f}s",
+          file=sys.stderr, flush=True)
     # post-registration waves: prefix-cache hits compile the chunked
     # tail-prefill program per (batch, tail) bucket — cover the batch
     # buckets steady-state admission uses, or each lands as a ~25s
@@ -100,12 +105,15 @@ def main():
         nb *= 2
     waves.append(nb)
     for wave in reversed(waves):
+        t_wave = time.time()
         ws = [server.engine.submit(list(range(2, 2 + args.prompt_len)),
                                    args.max_new_tokens)
               for _ in range(wave)]
         server._wake.set()
         for w in ws:
-            w.done_event.wait(timeout=600)
+            w.done_event.wait(timeout=3600)
+        print(f"# warmup: batch bucket {wave} done in "
+              f"{time.time() - t_wave:.0f}s", file=sys.stderr, flush=True)
     for k in server.engine.metrics:
         server.engine.metrics[k] = 0
 

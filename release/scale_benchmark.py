@@ -179,18 +179,22 @@ def bench_broadcast(nodes: int, mib: int):
         ref = ray_tpu.put(arr)
 
         @ray_tpu.remote(num_cpus=0.5)
-        def touch(a):
+        def touch(refs):
             import ray_tpu as rtpu
+            from ray_tpu.core.runtime import get_runtime
 
+            a = rtpu.get(refs[0])
+            src = get_runtime()._pull_sources.get(refs[0].id)
             return (int(a[0]) + len(a),
-                    rtpu.get_runtime_context().get_node_id())
+                    rtpu.get_runtime_context().get_node_id(),
+                    tuple(src) if src else None)
 
         t0 = time.time()
         refs = []
         for ni in nodes_info:
             refs.append(touch.options(
                 scheduling_strategy=NodeAffinitySchedulingStrategy(
-                    node_id=ni["NodeID"])).remote(ref))
+                    node_id=ni["NodeID"])).remote([ref]))
         out = ray_tpu.get(refs, timeout=600)
         dt = time.time() - t0
         # the measurement is only a broadcast if every pull ran on its
@@ -201,8 +205,14 @@ def bench_broadcast(nodes: int, mib: int):
         assert ran_on == want_on, \
             f"affinity violated: ran on {ran_on} wanted {want_on}"
         assert all(o[0] == out[0][0] for o in out)
+        # distribution-tree evidence: how many distinct holders served
+        # the fan-in (serve cap + busy-retry lets later pullers source
+        # from earlier pullers' registered copies, not just the owner)
+        sources = [o[2] for o in out]
+        assert all(s is not None for s in sources), sources
         _emit("broadcast", mib * len(nodes_info) / dt, "MiB/s",
-              mib=mib, nodes=len(nodes_info), total_s=round(dt, 1))
+              mib=mib, nodes=len(nodes_info), total_s=round(dt, 1),
+              distinct_pull_sources=len(set(sources)))
     finally:
         cluster.shutdown()
 
