@@ -126,8 +126,9 @@ class LLMEngine:
 
             params = shard_params(mesh, params, llama.param_specs(cfg), rules)
         if quantize == "int8" and not quantized:
-            # weight-only int8: HBM at rest halves vs bf16 (7B: ~6.8 GB),
-            # layers dequantize transiently inside each scan body.
+            # weight-only int8: HBM at rest halves vs bf16 (7B: ~6.8 GB);
+            # weights dequantize inside the consuming dots. Idempotent:
+            # already-quantized caller trees pass through unchanged.
             # (After sharding: the quantized tree's {"q8","s8"} leaves no
             # longer match param_specs.)
             params = llama.quantize_params_int8(params)
@@ -807,7 +808,10 @@ class LLMEngine:
         import jax
         import jax.numpy as jnp
 
+        t_adm = time.time()
         self._admit()
+        self.metrics["admit_s"] = \
+            self.metrics.get("admit_s", 0.0) + (time.time() - t_adm)
         with self.lock:
             active_reqs = [r for r in self.slots if self._decode_ready(r)]
             active_mask = np.array(
@@ -850,6 +854,7 @@ class LLMEngine:
                 return occupied
         if n_eff <= 1:
             return self.step()
+        t_blk = time.time()
         if self.kv_layout == "paged":
             act = self._sync_paged_device_state(active_mask, temps)
             (toks, self._last, self.kp, self.vp, self._len_dev,
@@ -867,6 +872,14 @@ class LLMEngine:
                 self._active_dev, self._temps_dev, self._key, n_eff)
         toks = np.asarray(toks)  # the block's single host fetch
         now = time.time()
+        # per-block wall (dispatch + device + the one fetch): attributes
+        # serving throughput between engine time and transport weather
+        self.metrics["decode_block_s"] = \
+            self.metrics.get("decode_block_s", 0.0) + (now - t_blk)
+        self.metrics["decode_blocks"] = \
+            self.metrics.get("decode_blocks", 0) + 1
+        self.metrics["decode_block_tokens"] = \
+            self.metrics.get("decode_block_tokens", 0) + n_eff
         for r in list(active_reqs):
             for j in range(n_eff):
                 if r.slot < 0:

@@ -41,6 +41,18 @@ def measure_tunnel_rtt(n: int = 20) -> float:
     return (time.perf_counter() - t0) / n
 
 
+def _cache_init(llama, cfg, quantize: str):
+    """The engine's own init recipe (serve dtype + optional int8), run
+    host-side so it can be cached across benchmark invocations."""
+    import jax
+
+    params = llama.init_params(jax.random.PRNGKey(0),
+                               cfg.replace(param_dtype=cfg.dtype))
+    if quantize == "int8":
+        params = llama.quantize_params_int8(params)
+    return params
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="tiny")
@@ -55,6 +67,18 @@ def main():
     ap.add_argument("--num-pages", type=int, default=None)
     ap.add_argument("--max-slots", type=int, default=None)
     ap.add_argument("--max-queue-depth", type=int, default=None)
+    ap.add_argument("--max-seq-len", type=int, default=None,
+                    help="engine sequence budget (default: the preset's "
+                    "max_seq_len). The paged decode kernel's grid and the "
+                    "tail-prefill attention view scale with THIS, not with "
+                    "live tokens — size it to the serving workload "
+                    "(prompt+new rounded up) or pay for max_seq worth of "
+                    "clamped grid steps per decode")
+    ap.add_argument("--params-cache", default=None,
+                    help="npz path to cache initialized (and quantized) "
+                    "params: a 7B host-side random init costs ~20 min of "
+                    "one vCPU per run; the cache turns reruns into a "
+                    "~1 min disk load")
     ap.add_argument("--quantize", choices=["none", "int8"], default="none",
                     help="weight-only int8: at-rest HBM halves (7B fits "
                     "one 16 GB v5e chip), layers dequantize in-scan")
@@ -70,12 +94,59 @@ def main():
     max_slots = args.max_slots or args.concurrency
     # admission control is layout-independent: pass the depth always
     kw = {"max_queue_depth": args.max_queue_depth}
+    if args.max_seq_len:
+        kw["max_seq_len"] = args.max_seq_len
     if args.kv_layout == "paged":
         kw.update(kv_layout="paged", page_size=args.page_size,
                   num_pages=args.num_pages,
                   prefix_caching=args.prefix_caching == "on")
     if args.quantize != "none":
         kw["quantize"] = args.quantize
+    if args.params_cache:
+        import jax
+        import numpy as _np
+
+        from ray_tpu.models import llama
+
+        cfg = llama.PRESETS[args.preset]
+        import ml_dtypes
+
+        treedef = jax.tree.structure(jax.eval_shape(
+            lambda: _cache_init(llama, cfg, args.quantize)))
+        fingerprint = f"{args.preset}|{args.quantize}"
+        if os.path.exists(args.params_cache):
+            flat = dict(_np.load(args.params_cache))
+            got = str(flat.get("fingerprint", ""))
+            if got != fingerprint:
+                sys.exit(f"--params-cache {args.params_cache} was built "
+                         f"for '{got}', this run needs '{fingerprint}' — "
+                         "delete it or point at a different path")
+            n = sum(1 for k in flat if k.startswith("a"))
+            leaves = []
+            for i in range(n):
+                a = flat[f"a{i}"]
+                dt = str(flat[f"d{i}"])
+                if a.dtype.kind in ("V", "u") and dt == "bfloat16":
+                    a = a.view(ml_dtypes.bfloat16)
+                leaves.append(a)
+            tree = jax.tree.unflatten(treedef, leaves)
+            kw["params"] = jax.device_put(tree, jax.devices()[0])
+            print("# params loaded from cache", file=sys.stderr, flush=True)
+        else:
+            with jax.default_device(jax.devices("cpu")[0]):
+                tree = _cache_init(llama, cfg, args.quantize)
+            out = {"fingerprint": _np.asarray(fingerprint)}
+            for i, v in enumerate(jax.tree.leaves(tree)):
+                a = _np.asarray(v)
+                out[f"d{i}"] = _np.asarray(str(a.dtype))
+                # npz cannot round-trip ml_dtypes.bfloat16 — store the
+                # raw uint16 view and re-view on load
+                out[f"a{i}"] = (a.view(_np.uint16)
+                                if a.dtype == ml_dtypes.bfloat16 else a)
+            _np.savez(args.params_cache, **out)
+            kw["params"] = jax.device_put(tree, jax.devices()[0])
+            print("# params initialized and cached", file=sys.stderr,
+                  flush=True)
     server = LLMServer(preset=args.preset, max_slots=max_slots,
                        decode_block=args.decode_block, **kw)
     rtt = measure_tunnel_rtt()
