@@ -121,6 +121,9 @@ def run_train_bench(preset: str = "debug-125m", batch=None, seq=None,
 
     n_params = llama.num_params(cfg)
     L, D = cfg.n_layers, cfg.d_model
+    # 125M MFU ceiling note: the preset's head_dim-64 attention half-fills
+    # the MXU's 128-wide lane tile — the same params at 6x128 heads
+    # measure 59.1% vs 42.8% (release/mfu_sweep.py --only struct:, r5).
     flops_per_step = 6 * n_params * tokens_per_step \
         + 12 * L * B * S * S * D            # attention fwd+bwd
     mfu = flops_per_step / dt_s / detect_peak(dev)

@@ -22,6 +22,13 @@ import sys
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None,
+                    help="label prefix filter (e.g. 'struct:' runs only "
+                    "the structural-attribution probes + the baseline)")
+    args = ap.parse_args()
     sys.path.insert(0, ".")
     from bench import run_train_bench
 
@@ -59,6 +66,13 @@ def main():
          "overrides": {"n_heads": 6, "n_kv_heads": 6,
                        "vocab_size": 8000}},
     ]
+    if args.only:
+        matched = [c for c in configs if c["label"].startswith(args.only)]
+        if not matched:
+            sys.exit(f"--only {args.only!r} matches no config label "
+                     f"(have: {[c['label'] for c in configs]})")
+        configs = ([c for c in configs if c["label"] == "r3-baseline"]
+                   + matched)
     best = None
     for c in configs:
         try:
