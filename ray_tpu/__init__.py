@@ -247,10 +247,19 @@ class RuntimeContext:
         # driver task id, which is for put-id spaces, not user context)
         tid = getattr(rt._exec_ctx, "task_id", None)
         self.task_id = tid.hex() if tid is not None else None
+        # per-execution-context (thread/asyncio-task), NOT per-process:
+        # lane-packed actors share a process, so this is the only
+        # reliable "which actor am I" (ref: RuntimeContext.get_actor_id)
+        aid = getattr(rt._exec_ctx, "actor_id", None)
+        self.actor_id = aid.hex() if aid is not None else None
         self.worker_mode = rt.mode
 
     def get_node_id(self) -> str:
         return self.node_id
+
+    def get_actor_id(self):
+        """Id of the actor whose method is executing, else None."""
+        return self.actor_id
 
     def get_job_id(self) -> str:
         return self.job_id

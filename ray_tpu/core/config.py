@@ -66,6 +66,12 @@ class Config:
     # without a 0.5+ s interpreter spawn per actor (ref: the reference's
     # one-process-per-actor model tops out at worker-spawn rate; its 40k
     # actor benchmark uses num_cpus=0.001). 0 disables lane packing.
+    # SEMANTIC TRADE: lane-packed actors share an interpreter, so
+    # per-PROCESS state (module globals, class attributes) is shared
+    # across them where the reference isolates it. Actor code needing
+    # "which actor am I" must use get_runtime_context().get_actor_id()
+    # (per lane thread), as util/collective does; actors needing real
+    # process isolation should request num_cpus>=1.
     actor_lanes_per_worker: int = 16
     worker_idle_timeout_s: float = 300.0
     scheduler_spread_threshold: float = 0.5      # ref: RAY_scheduler_spread_threshold

@@ -57,6 +57,12 @@ logger = logging.getLogger("ray_tpu.runtime")
 #: (source serve cap) — retry with refreshed locations; NOT lost.
 _BUSY = object()
 
+#: Called with the actor id (hex) when a LANE actor is torn down without
+#: its process dying — modules holding per-actor state (util/collective)
+#: register a pruner here, since lane packing breaks the reference's
+#: "actor death == process death" cleanup.
+actor_teardown_hooks: list = []
+
 _runtime_lock = threading.Lock()
 _global_runtime: Optional["Runtime"] = None
 
@@ -515,11 +521,16 @@ class Runtime:
     # ---------------------------------------------------------------- objects
 
     def set_exec_context(self, task_id: TaskID,
-                         runtime_env: Optional[dict] = None):
+                         runtime_env: Optional[dict] = None,
+                         actor_id=None):
         # Nested submissions from inside this task inherit its env
-        # (ref: runtime_env inheritance parent → child).
+        # (ref: runtime_env inheritance parent → child). actor_id rides
+        # along so get_runtime_context().get_actor_id() works per LANE
+        # thread — lane-packed actors share one process, so process
+        # identity no longer identifies the actor.
         self._exec_ctx._replace({"task_id": task_id, "put_index": 0,
-                                 "runtime_env": runtime_env})
+                                 "runtime_env": runtime_env,
+                                 "actor_id": actor_id})
 
     def clear_exec_context(self):
         self._exec_ctx._replace({})

@@ -251,7 +251,9 @@ class Worker:
         lane = (self.lanes.get(spec.actor_id)
                 if spec.is_actor_call else None)
         env = spec.runtime_env or (lane.spec.runtime_env if lane else None)
-        self.runtime.set_exec_context(spec.task_id, runtime_env=env)
+        self.runtime.set_exec_context(
+            spec.task_id, runtime_env=env,
+            actor_id=spec.actor_id if spec.is_actor_call else None)
         with self._cancel_lock:
             self._exec_threads[spec.task_id] = threading.get_ident()
             if lane is not None:
@@ -317,7 +319,8 @@ class Worker:
             from ray_tpu.util.tracing import continue_trace
 
             self.runtime.set_exec_context(spec.task_id,
-                                          runtime_env=spec.runtime_env)
+                                          runtime_env=spec.runtime_env,
+                                          actor_id=spec.actor_id)
             try:
                 # The actor owns its lane: its runtime env persists for
                 # the actor's lifetime (entered, never exited — ref: actors
@@ -377,6 +380,14 @@ class Worker:
                         ctypes.py_object(KeyboardInterrupt))
         lane.executor.shutdown(wait=False, cancel_futures=True)
         lane.instance = None
+        # lane death is NOT process death: per-actor module state (e.g.
+        # util/collective's group clients) must be released explicitly
+        from ray_tpu.core.runtime import actor_teardown_hooks
+        for hook in list(actor_teardown_hooks):
+            try:
+                hook(actor_id.hex())
+            except Exception:
+                logger.exception("actor teardown hook failed")
         return {"ok": True}
 
     async def rpc_push_actor_task(self, spec: TaskSpec) -> TaskResult:
@@ -401,7 +412,8 @@ class Worker:
                 try:
                     args, kwargs = await loop.run_in_executor(
                         lane.executor, self._resolve_args, spec)
-                    self.runtime.set_exec_context(spec.task_id)
+                    self.runtime.set_exec_context(spec.task_id,
+                                                  actor_id=spec.actor_id)
                     agen = method(*args, **kwargs)
                     idx = 0
                     async for item in agen:
@@ -433,7 +445,8 @@ class Worker:
                 try:
                     args, kwargs = await loop.run_in_executor(
                         lane.executor, self._resolve_args, spec)
-                    self.runtime.set_exec_context(spec.task_id)
+                    self.runtime.set_exec_context(spec.task_id,
+                                                  actor_id=spec.actor_id)
                     value = await method(*args, **kwargs)
                     return self._package_returns(spec, value)
                 except BaseException as e:

@@ -20,7 +20,33 @@ import numpy as np
 
 import ray_tpu
 
-_groups: Dict[str, "_GroupClient"] = {}
+#: Keyed by (calling actor id, group name), NOT group name alone: the
+#: reference keys per-process because one actor == one process there —
+#: with lane-packed fractional-CPU actors sharing a worker process,
+#: per-process group state would let rank N's init clobber rank M's
+#: (their allreduce then deadlocks waiting for ranks that can never
+#: arrive — found by the suite's collective test once its members
+#: became lane-packed).
+_groups: Dict[tuple, "_GroupClient"] = {}
+
+
+def _ctx() -> Optional[str]:
+    try:
+        return ray_tpu.get_runtime_context().get_actor_id()
+    except Exception:
+        return None
+
+
+def _on_actor_teardown(actor_id_hex: str) -> None:
+    """Lane actors die without their process dying: drop their group
+    clients so a churning fleet cannot grow _groups unboundedly."""
+    for key in [k for k in _groups if k[0] == actor_id_hex]:
+        _groups.pop(key, None)
+
+
+from ray_tpu.core.runtime import actor_teardown_hooks as _hooks  # noqa: E402
+
+_hooks.append(_on_actor_teardown)
 
 
 @ray_tpu.remote
@@ -110,11 +136,12 @@ class _GroupClient:
 def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
     """ref: collective.py:120."""
-    _groups[group_name] = _GroupClient(group_name, world_size, rank)
+    _groups[(_ctx(), group_name)] = _GroupClient(group_name, world_size,
+                                                 rank)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    g = _groups.pop(group_name, None)
+    g = _groups.pop((_ctx(), group_name), None)
     if g and g.rank == 0:
         try:
             ray_tpu.kill(g.coord)
@@ -123,9 +150,26 @@ def destroy_collective_group(group_name: str = "default") -> None:
 
 
 def _group(name: str) -> _GroupClient:
-    if name not in _groups:
-        raise RuntimeError(f"collective group {name!r} not initialized")
-    return _groups[name]
+    key = (_ctx(), name)
+    g = _groups.get(key)
+    if g is not None:
+        return g
+    # Helper threads an actor spawns itself start with a fresh context
+    # (no actor id). If exactly ONE client for this group name lives in
+    # the process, that use is unambiguous — honor it (the per-process
+    # reference semantics). Multiple same-name clients (lane-packed
+    # ranks) make a context-less call genuinely ambiguous.
+    candidates = [g for (a, n), g in _groups.items() if n == name]
+    if len(candidates) == 1:
+        return candidates[0]
+    if candidates:
+        raise RuntimeError(
+            f"collective group {name!r}: ambiguous caller — "
+            f"{len(candidates)} lane-packed actors initialized this "
+            "group in one process, and this call carries no actor "
+            "context (e.g. a self-spawned thread). Call from an actor "
+            "method, or propagate contextvars into the thread")
+    raise RuntimeError(f"collective group {name!r} not initialized")
 
 
 def allreduce(tensor: np.ndarray, group_name: str = "default") -> np.ndarray:

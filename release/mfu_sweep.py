@@ -71,8 +71,9 @@ def main():
         if not matched:
             sys.exit(f"--only {args.only!r} matches no config label "
                      f"(have: {[c['label'] for c in configs]})")
-        configs = ([c for c in configs if c["label"] == "r3-baseline"]
-                   + matched)
+        baseline = [c for c in configs if c["label"] == "r3-baseline"
+                    and c not in matched]
+        configs = baseline + matched
     best = None
     for c in configs:
         try:

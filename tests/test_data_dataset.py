@@ -517,10 +517,15 @@ def test_map_batches_actor_pool(ray_start_regular):
         def __init__(self, bias):
             self.bias = bias          # "expensive model load"
             self.pid = os.getpid()
+            # identity of THIS pool actor: fractional-CPU pool actors may
+            # lane-pack into one process, so pid no longer distinguishes
+            # them — actor id (per lane execution context) does
+            self.tag = hash(ray_tpu.get_runtime_context().get_actor_id())
 
         def __call__(self, batch):
             return {"id": batch["id"] + self.bias,
-                    "pid": np.full(len(batch["id"]), self.pid)}
+                    "tag": np.full(len(batch["id"]), self.tag,
+                                   dtype=np.int64)}
 
     ds = data.range(64, num_blocks=8).map_batches(
         AddModelBias, compute=ActorPoolStrategy(size=2),
@@ -528,8 +533,8 @@ def test_map_batches_actor_pool(ray_start_regular):
     rows = ds.take_all()
     assert len(rows) == 64
     assert sorted(r["id"] for r in rows) == list(range(1000, 1064))
-    # 8 blocks ran on exactly 2 actor processes
-    assert len({int(r["pid"]) for r in rows}) == 2
+    # 8 blocks ran on exactly 2 pool actors (one ctor each)
+    assert len({int(r["tag"]) for r in rows}) == 2
 
 
 def test_map_batches_actor_pool_after_lazy_ops(ray_start_regular):

@@ -47,7 +47,9 @@ def test_collective_allreduce(ray_start_regular):
     world = 3
     members = [Member.options(num_cpus=0.5).remote(i, world)
                for i in range(world)]
-    outs = ray_tpu.get([m.run.remote() for m in members], timeout=60)
+    # 3 worker spawns (~5 s of jax import each) + the rendezvous must
+    # survive a loaded box (the suite runs under a deliberate CPU hog)
+    outs = ray_tpu.get([m.run.remote() for m in members], timeout=240)
     for total, n in outs:
         assert total == [6.0, 6.0, 6.0, 6.0]   # 1+2+3
         assert n == world
