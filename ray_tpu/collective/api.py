@@ -1,0 +1,325 @@
+"""Public host-collective API.
+
+Reference: python/ray/util/collective/collective.py (GroupManager:40,
+init_collective_group:120, allreduce:258, barrier:298, broadcast:373,
+allgather:423, reducescatter:472). Backends are host-topology-aware
+algorithms over the object store (registry.py) instead of NCCL/Gloo
+process groups; *device* collectives stay inside jitted programs
+(ray_tpu.parallel — see ARCHITECTURE.md "Host collectives").
+
+Contracts:
+
+- Every rank must issue the same ops in the same order on a group
+  (standard collective semantics; rounds are tied by sequence number).
+- SUM is the reduction (same as the legacy coordinator).
+- Payloads: numpy arrays, scalars, or pytrees (nested dict/list/tuple)
+  of them for allreduce; arbitrary picklable values for
+  allgather/broadcast.
+- A member death or stall surfaces as ``CollectiveError`` (usually the
+  ``CollectiveTimeoutError`` subclass, naming suspect ranks) on every
+  surviving rank within roughly the group's ``timeout_s`` — no deadlock.
+- ``*_async`` variants return ``concurrent.futures.Future`` and run on a
+  per-group thread, overlapping host communication with caller compute;
+  per group they execute in submission order.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.collective import pytree as _pt
+from ray_tpu.collective.errors import CollectiveError, CollectiveTimeoutError
+from ray_tpu.collective.group import GroupContext
+from ray_tpu.collective.registry import (available_backends,
+                                         get_backend_factory,
+                                         register_backend, select_backend)
+from ray_tpu.collective.topology import Topology
+
+#: Keyed by (calling actor id, group name), NOT group name alone:
+#: lane-packed fractional-CPU actors share a worker process, so
+#: per-process state would let rank N's init clobber rank M's (their
+#: allreduce then deadlocks waiting for ranks that can never arrive).
+_groups: Dict[tuple, "GroupClient"] = {}
+
+
+def _ctx() -> Optional[str]:
+    try:
+        return ray_tpu.get_runtime_context().get_actor_id()
+    except Exception:
+        return None
+
+
+def _on_actor_teardown(actor_id_hex: str) -> None:
+    """Lane actors die without their process dying: drop their group
+    clients so a churning fleet cannot grow _groups unboundedly."""
+    for key in [k for k in _groups if k[0] == actor_id_hex]:
+        g = _groups.pop(key, None)
+        if g is not None:
+            g.close_local()
+
+
+from ray_tpu.core.runtime import actor_teardown_hooks as _hooks  # noqa: E402
+
+_hooks.append(_on_actor_teardown)
+
+
+class GroupClient:
+    """One rank's membership in one collective group."""
+
+    def __init__(self, name: str, world_size: int, rank: int,
+                 backend: str = "auto", timeout_s: float = 60.0,
+                 pipeline_chunks: int = 4):
+        if backend != "auto":
+            get_backend_factory(backend)     # fail fast on unknown names
+        self.ctx = GroupContext(name, world_size, rank, timeout_s=timeout_s)
+        self.requested_backend = backend
+        self.pipeline_chunks = pipeline_chunks
+        self._instances: Dict[str, Any] = {}
+        self._op_lock = threading.Lock()     # serializes sync vs async ops
+        self._executor: Optional[ThreadPoolExecutor] = None
+
+    # -- plumbing --------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.ctx.name
+
+    @property
+    def rank(self) -> int:
+        return self.ctx.rank
+
+    @property
+    def world(self) -> int:
+        return self.ctx.world
+
+    @property
+    def topology(self) -> Topology:
+        return self.ctx.topology
+
+    def _backend(self, op: str, payload_bytes: Optional[int] = None):
+        name = self.requested_backend
+        if name == "auto":
+            name = select_backend(op, self.world, self.ctx.topology,
+                                  payload_bytes)
+        inst = self._instances.get(name)
+        if inst is None:
+            factory = get_backend_factory(name)
+            try:
+                inst = factory(self.ctx, pipeline_chunks=self.pipeline_chunks)
+            except TypeError:
+                inst = factory(self.ctx)
+            self._instances[name] = inst
+        return inst
+
+    def _submit(self, fn, *args) -> Future:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix=f"col-{self.name}-r{self.rank}")
+        return self._executor.submit(fn, *args)
+
+    def close_local(self):
+        """Release this rank's local resources (not the group actors)."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+    # -- ops -------------------------------------------------------------
+
+    def allreduce(self, tensor):
+        with self._op_lock:
+            if _pt.is_leaf(tensor):
+                arr = np.asarray(tensor)
+                return self._backend("allreduce", arr.nbytes).allreduce(arr)
+            leaves, treedef = _pt.tree_flatten(tensor)
+            buffers, layout = _pt.pack_leaves(leaves)
+            reduced = [self._backend("allreduce", b.nbytes).allreduce(b)
+                       for b in buffers]
+            return _pt.tree_unflatten(treedef,
+                                      _pt.unpack_leaves(reduced, layout))
+
+    def allgather(self, value) -> List[Any]:
+        with self._op_lock:
+            return self._backend("allgather").allgather(value)
+
+    def broadcast(self, value, src_rank: int = 0):
+        if not (0 <= src_rank < self.world):
+            raise ValueError(f"broadcast: src_rank {src_rank} outside "
+                             f"world of {self.world}")
+        with self._op_lock:
+            data = value if self.rank == src_rank else None
+            return self._backend("broadcast").broadcast(data, src_rank)
+
+    def reducescatter(self, tensor) -> np.ndarray:
+        arr = np.asarray(tensor)
+        if arr.ndim == 0:
+            raise ValueError("reducescatter: payload must have at least "
+                             "one dimension to scatter over")
+        if arr.shape[0] % self.world:
+            # the legacy coordinator silently returned ragged
+            # np.array_split chunks here — refuse instead
+            raise ValueError(
+                f"reducescatter: leading dim {arr.shape[0]} is not "
+                f"divisible by world_size {self.world}; pad the payload "
+                "or pick a scatterable batch dimension")
+        with self._op_lock:
+            return self._backend("reducescatter", arr.nbytes).reducescatter(arr)
+
+    def barrier(self) -> None:
+        with self._op_lock:
+            self._backend("barrier").barrier()
+
+    def destroy(self):
+        self.close_local()
+        self.ctx.destroy()
+
+
+# --------------------------------------------------------------------------
+# module-level API (the surface util/collective.py re-exports)
+# --------------------------------------------------------------------------
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default", *,
+                          backend: str = "auto",
+                          timeout_s: float = 60.0,
+                          pipeline_chunks: int = 4) -> None:
+    """Join `group_name` as `rank` of `world_size` (ref: collective.py:120).
+
+    backend: "auto" | "gather" | "ring" | "hier" | any registered name.
+    timeout_s: per-round deadline before surviving ranks raise
+        ``CollectiveTimeoutError`` (member-failure detection).
+    """
+    _groups[(_ctx(), group_name)] = GroupClient(
+        group_name, world_size, rank, backend=backend,
+        timeout_s=timeout_s, pipeline_chunks=pipeline_chunks)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear the group down: drops this rank's client AND kills the named
+    helper actors (coordinator + mailboxes) so repeated init/destroy
+    cycles cannot leak one named actor per group name. Call after the
+    fleet is done with the group (any rank may run the reaping)."""
+    g = _groups.pop((_ctx(), group_name), None)
+    if g is not None:
+        g.destroy()
+        return
+    # No local client (e.g. driver-side cleanup after members died):
+    # reap the named actors directly.
+    for suffix in [""] + [f"_mbx{r}" for r in range(1024)]:
+        name = f"_collective_{group_name}{suffix}"
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(name))
+        except ValueError:
+            if suffix != "":
+                break                    # contiguous ranks: first gap ends it
+        except Exception:
+            pass
+
+
+def _group(name: str) -> GroupClient:
+    key = (_ctx(), name)
+    g = _groups.get(key)
+    if g is not None:
+        return g
+    # Helper threads an actor spawns itself start with a fresh context
+    # (no actor id). If exactly ONE client for this group name lives in
+    # the process, that use is unambiguous — honor it (the per-process
+    # reference semantics). Multiple same-name clients (lane-packed
+    # ranks) make a context-less call genuinely ambiguous.
+    candidates = [g for (a, n), g in _groups.items() if n == name]
+    if len(candidates) == 1:
+        return candidates[0]
+    if candidates:
+        raise RuntimeError(
+            f"collective group {name!r}: ambiguous caller — "
+            f"{len(candidates)} lane-packed actors initialized this "
+            "group in one process, and this call carries no actor "
+            "context (e.g. a self-spawned thread). Call from an actor "
+            "method, or propagate contextvars into the thread")
+    raise RuntimeError(f"collective group {name!r} not initialized")
+
+
+def allreduce(tensor, group_name: str = "default"):
+    """SUM allreduce of an array or pytree (ref: collective.py:258)."""
+    return _group(group_name).allreduce(tensor)
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    return _group(group_name).allgather(tensor)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(tensor, src_rank)
+
+
+def reducescatter(tensor, group_name: str = "default") -> np.ndarray:
+    return _group(group_name).reducescatter(tensor)
+
+
+def barrier(group_name: str = "default") -> None:
+    _group(group_name).barrier()
+
+
+# -- async variants (compute/comm overlap) ---------------------------------
+
+
+def allreduce_async(tensor, group_name: str = "default") -> Future:
+    g = _group(group_name)
+    return g._submit(g.allreduce, tensor)
+
+
+def allgather_async(tensor, group_name: str = "default") -> Future:
+    g = _group(group_name)
+    return g._submit(g.allgather, tensor)
+
+
+def broadcast_async(tensor, src_rank: int = 0,
+                    group_name: str = "default") -> Future:
+    g = _group(group_name)
+    return g._submit(g.broadcast, tensor, src_rank)
+
+
+def reducescatter_async(tensor, group_name: str = "default") -> Future:
+    g = _group(group_name)
+    return g._submit(g.reducescatter, tensor)
+
+
+def barrier_async(group_name: str = "default") -> Future:
+    g = _group(group_name)
+    return g._submit(g.barrier)
+
+
+# -- introspection ---------------------------------------------------------
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world
+
+
+def get_group_topology(group_name: str = "default") -> Topology:
+    return _group(group_name).topology
+
+
+def transfer_stats(group_name: str = "default") -> dict:
+    """This rank's byte accounting (the bandwidth-optimality hook)."""
+    return _group(group_name).ctx.stats.snapshot()
+
+
+def reset_transfer_stats(group_name: str = "default") -> None:
+    _group(group_name).ctx.stats.reset()
+
+
+def coordinator_stats(group_name: str = "default") -> dict:
+    """The gather coordinator's fan-in accounting (bytes_in)."""
+    g = _group(group_name)
+    return ray_tpu.get(g.ctx.coord.stats.remote(), timeout=30)

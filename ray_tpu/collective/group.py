@@ -1,0 +1,389 @@
+"""Group bootstrap + the peer-to-peer transport every backend rides.
+
+Two kinds of helper actors per group:
+
+- one ``_Coordinator`` (created by rank 0, named ``_collective_{group}``)
+  — the legacy gather/broadcast rendezvous. It doubles as the bootstrap
+  barrier: every rank allgathers its (node id, mailbox handle) through
+  it once, which yields the membership table the ``Topology`` and the
+  peer-to-peer backends are built from.
+- one ``_Mailbox`` per rank (named ``_collective_{group}_mbx{rank}``) —
+  a keyed async slot store. Ring/hierarchical backends move chunks by
+  pushing into the *receiver's* mailbox (object-store peer-to-peer:
+  sender worker → receiver-mailbox worker, no global fan-in point) and
+  the receiver draining its own mailbox. Every ``take`` carries a
+  server-side timeout so a dead sender can never park a round forever.
+
+Failure detection: a timed-out ``take``/``exchange`` returns a sentinel
+instead of blocking; the client then pings every peer mailbox and raises
+``CollectiveTimeoutError`` naming the unresponsive ranks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.collective.errors import CollectiveError, CollectiveTimeoutError
+from ray_tpu.collective.topology import Topology
+
+#: Sentinel dict key marking a server-side timeout reply.
+TIMEOUT_KEY = "__col_timeout__"
+
+
+def _is_timeout(v) -> bool:
+    return isinstance(v, dict) and TIMEOUT_KEY in v
+
+
+# --------------------------------------------------------------------------
+# helper actors
+# --------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class _Mailbox:
+    """Keyed rendezvous slots for one rank's inbound collective traffic.
+
+    Methods are deliberately SYNCHRONOUS: with max_concurrency > 1 they
+    run on the worker's executor threads, where blocking is allowed —
+    packaging a large return pins it in the object store via a blocking
+    nodelet RPC, which the runtime forbids on the event-loop thread (an
+    async ``take`` returning a big chunk would trip that guard)."""
+
+    def __init__(self):
+        import threading
+
+        self.slots: Dict[str, Any] = {}
+        self.cv = threading.Condition()
+
+    def put(self, key: str, value) -> bool:
+        with self.cv:
+            self.slots[key] = value
+            self.cv.notify_all()
+        return True
+
+    def take(self, key: str, timeout_s: float):
+        """Block until `key` arrives (or time out → sentinel), then pop it."""
+        with self.cv:
+            if not self.cv.wait_for(lambda: key in self.slots,
+                                    timeout=timeout_s):
+                return {TIMEOUT_KEY: key}
+            return self.slots.pop(key)
+
+    def ping(self) -> bool:
+        return True
+
+
+@ray_tpu.remote
+class _Coordinator:
+    """Gather-style rendezvous: every rank contributes, everyone gets the
+    combined result (the legacy O(world × bytes) funnel — kept as the
+    ``gather`` backend and as the bootstrap allgather)."""
+
+    def __init__(self, world_size: int):
+        import threading
+
+        self.world = world_size
+        self.rounds: Dict[tuple, dict] = {}
+        self.cv = threading.Condition()
+        self.bytes_in = 0          # transfer accounting: fan-in volume
+
+    def exchange(self, op: str, seq: int, rank: int, data,
+                 timeout_s: float = 300.0):
+        """All ranks call with their contribution; returns the combined
+        result once everyone arrived, or a timeout sentinel naming the
+        ranks that never showed up. Synchronous on purpose — see _Mailbox
+        (large combined results must be packaged off the event loop)."""
+        key = (op, seq)
+        if isinstance(data, np.ndarray):
+            self.bytes_in += int(data.nbytes)
+        with self.cv:
+            slot = self.rounds.setdefault(key, {"parts": {}, "result": None})
+            slot["parts"][rank] = data
+            if len(slot["parts"]) == self.world:
+                slot["result"] = self._combine(op, slot["parts"])
+                self.cv.notify_all()
+            else:
+                def done():
+                    s = self.rounds.get(key)
+                    return s is None or s["result"] is not None
+
+                if not self.cv.wait_for(done, timeout=timeout_s):
+                    missing = [r for r in range(self.world)
+                               if r not in slot["parts"]]
+                    return {TIMEOUT_KEY: missing}
+            result = self.rounds[key]["result"][rank]
+            slot["parts"].pop(rank, None)
+            if not slot["parts"]:
+                self.rounds.pop(key, None)
+            return result
+
+    def _combine(self, op: str, parts_by_rank: Dict[int, Any]) -> list:
+        parts = [parts_by_rank[r] for r in range(self.world)]
+        if op == "allreduce_sum":
+            out = parts[0]
+            for p in parts[1:]:
+                out = out + p
+            return [out] * self.world
+        if op == "allgather":
+            return [list(parts)] * self.world
+        if op == "barrier":
+            return [True] * self.world
+        if op == "broadcast":
+            srcs = [p for p in parts if p is not None]
+            if not srcs:
+                # every rank passed None: a bare StopIteration here would
+                # vanish inside the async handler — name the misuse
+                raise ValueError(
+                    "broadcast: no source rank provided data")
+            return [srcs[0]] * self.world
+        if op == "reducescatter":
+            total = parts[0]
+            for p in parts[1:]:
+                total = total + p
+            return list(np.array_split(total, self.world))
+        raise ValueError(op)
+
+    def stats(self) -> dict:
+        return {"bytes_in": self.bytes_in}
+
+    def ping(self) -> bool:
+        return True
+
+
+# --------------------------------------------------------------------------
+# transfer accounting
+# --------------------------------------------------------------------------
+
+
+def payload_nbytes(obj) -> int:
+    """Approximate wire size of a collective payload."""
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes)
+    if isinstance(obj, (bytes, bytearray)):
+        return len(obj)
+    if isinstance(obj, (list, tuple)):
+        return sum(payload_nbytes(o) for o in obj)
+    if isinstance(obj, dict):
+        return sum(payload_nbytes(o) for o in obj.values())
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return 8
+    try:
+        import pickle
+
+        return len(pickle.dumps(obj, protocol=5))
+    except Exception:
+        return 0
+
+
+class TransferStats:
+    """Per-rank byte accounting — the hook the bandwidth-optimality tests
+    and ``bench.py --bench collective`` assert against."""
+
+    def __init__(self):
+        self.bytes_sent = 0          # total payload bytes this rank pushed
+        self.bytes_sent_inter = 0    # subset that crossed a node boundary
+        self.bytes_recv = 0
+        self.sends = 0
+        self.recvs = 0
+
+    def snapshot(self) -> dict:
+        return {"bytes_sent": self.bytes_sent,
+                "bytes_sent_inter": self.bytes_sent_inter,
+                "bytes_recv": self.bytes_recv,
+                "sends": self.sends, "recvs": self.recvs}
+
+    def reset(self):
+        self.__init__()
+
+
+# --------------------------------------------------------------------------
+# group context
+# --------------------------------------------------------------------------
+
+
+def _actor_name(group: str, suffix: str = "") -> str:
+    return f"_collective_{group}{suffix}"
+
+
+def _resolve_named(name: str, deadline_s: float = 30.0):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            return ray_tpu.get_actor(name)
+        except ValueError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+class GroupContext:
+    """One rank's view of a collective group: membership, topology,
+    mailbox handles, sequencing, transfer accounting.
+
+    Ops must be issued in the same order on every rank (standard
+    collective contract); ``seq`` ties the rounds together.
+    """
+
+    def __init__(self, name: str, world_size: int, rank: int,
+                 timeout_s: float = 60.0):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} outside world of {world_size}")
+        self.name = name
+        self.world = world_size
+        self.rank = rank
+        self.timeout_s = float(timeout_s)
+        self.seq = 0
+        self.stats = TransferStats()
+        self.mailboxes: Dict[int, Any] = {}
+
+        coord_name = _actor_name(name)
+        mbx_name = _actor_name(name, f"_mbx{rank}")
+        # Own mailbox first (peers resolve it by name), then rank 0 brings
+        # up the coordinator everyone bootstraps through.
+        # Fractional CPU on purpose: 0 < cpu < 1 makes helper actors
+        # lane-packable (nodelet._laneable) so a group's whole helper
+        # fleet shares one worker process instead of each holding a
+        # max_workers_per_node slot — many live groups would otherwise
+        # exhaust the worker cap and wedge the next group's bootstrap.
+        self.mailbox = _Mailbox.options(
+            name=mbx_name, num_cpus=0.01,
+            max_concurrency=max(4 * world_size, 16)).remote()
+        if rank == 0:
+            try:
+                self.coord = _Coordinator.options(
+                    name=coord_name, num_cpus=0.01,
+                    max_concurrency=max(world_size * 2, 4)).remote(world_size)
+            except ValueError:
+                self.coord = _resolve_named(coord_name)
+        else:
+            self.coord = _resolve_named(coord_name)
+
+        try:
+            node_id = ray_tpu.get_runtime_context().get_node_id()
+        except Exception:
+            node_id = "local"
+        # Bootstrap budget is deliberately generous: joining can pay for
+        # several fresh worker-process spawns (~5 s of jax import each,
+        # more on a loaded box) before the first rank even registers.
+        table = self.coord_exchange(
+            "allgather", {"rank": rank, "node": node_id,
+                          "mailbox": self.mailbox},
+            timeout_s=max(self.timeout_s, 120.0))
+        self.mailboxes = {e["rank"]: e["mailbox"] for e in table}
+        self.topology = Topology.build({e["rank"]: e["node"] for e in table})
+
+    # -- coordinator path (gather backend + bootstrap) -------------------
+
+    def coord_exchange(self, op: str, data, timeout_s: Optional[float] = None):
+        t = self.timeout_s if timeout_s is None else timeout_s
+        self.seq += 1
+        if isinstance(data, np.ndarray):
+            self.stats.bytes_sent += int(data.nbytes)
+            self.stats.sends += 1
+        out = self._checked_get(
+            self.coord.exchange.remote(op, self.seq, self.rank, data, t),
+            op=op, budget_s=t)
+        if _is_timeout(out):
+            raise CollectiveTimeoutError(
+                f"collective {op} (group {self.name!r}, seq {self.seq}) "
+                f"timed out after {t:.1f}s waiting for ranks {out[TIMEOUT_KEY]}",
+                group_name=self.name, op=op, suspect_ranks=out[TIMEOUT_KEY])
+        return out
+
+    # -- peer-to-peer path (ring / hier backends) ------------------------
+
+    def send(self, dst_rank: int, key: str, payload) -> None:
+        """Fire-and-forget push into dst's mailbox (object-store p2p)."""
+        n = payload_nbytes(payload)
+        self.stats.bytes_sent += n
+        self.stats.sends += 1
+        if self.topology.node_of(dst_rank) != self.topology.node_of(self.rank):
+            self.stats.bytes_sent_inter += n
+        self.mailboxes[dst_rank].put.remote(key, payload)
+
+    def recv(self, src_rank: int, key: str, *, op: str = ""):
+        """Blocking take from OWN mailbox of the value `src_rank` pushed."""
+        out = self._checked_get(
+            self.mailbox.take.remote(key, self.timeout_s),
+            op=op, budget_s=self.timeout_s)
+        if _is_timeout(out):
+            suspects = self.probe_peers()
+            detail = suspects or "none — peers alive but round stalled"
+            raise CollectiveTimeoutError(
+                f"collective {op or 'op'} (group {self.name!r}) timed out "
+                f"after {self.timeout_s:.1f}s waiting on rank {src_rank} "
+                f"(key {key!r}); unresponsive ranks: {detail}",
+                group_name=self.name, op=op,
+                suspect_ranks=suspects or [src_rank])
+        self.stats.bytes_recv += payload_nbytes(out)
+        self.stats.recvs += 1
+        return out
+
+    def _checked_get(self, ref, *, op: str, budget_s: float):
+        """get() that converts transport failures into CollectiveError."""
+        try:
+            # modest slack over the server-side timeout: the sentinel is
+            # the primary mechanism, this is the belt for a dead mailbox
+            return ray_tpu.get(ref, timeout=budget_s + 15.0)
+        except (ray_tpu.exceptions.ActorDiedError,
+                ray_tpu.exceptions.ActorUnavailableError,
+                ray_tpu.exceptions.WorkerCrashedError) as e:
+            suspects = self.probe_peers()
+            raise CollectiveError(
+                f"collective {op or 'op'} (group {self.name!r}) lost a "
+                f"member: {e}; unresponsive ranks: {suspects}",
+                group_name=self.name, op=op, suspect_ranks=suspects) from e
+        except ray_tpu.exceptions.GetTimeoutError as e:
+            suspects = self.probe_peers()
+            raise CollectiveTimeoutError(
+                f"collective {op or 'op'} (group {self.name!r}) timed out "
+                f"after {budget_s:.1f}s; unresponsive ranks: {suspects}",
+                group_name=self.name, op=op, suspect_ranks=suspects) from e
+        except ray_tpu.exceptions.TaskError as e:
+            cause = getattr(e, "cause", None)
+            if isinstance(cause, (ValueError, CollectiveError)):
+                raise cause
+            raise
+
+    def probe_peers(self, probe_timeout_s: float = 3.0) -> List[int]:
+        """Ping every peer mailbox; return ranks that did not answer."""
+        refs, order = [], []
+        for r, mbx in self.mailboxes.items():
+            if r == self.rank:
+                continue
+            try:
+                refs.append(mbx.ping.remote())
+                order.append(r)
+            except Exception:
+                order.append(r)
+                refs.append(None)
+        suspects = []
+        for r, ref in zip(order, refs):
+            if ref is None:
+                suspects.append(r)
+                continue
+            try:
+                ray_tpu.get(ref, timeout=probe_timeout_s)
+            except Exception:
+                suspects.append(r)
+        return sorted(suspects)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def destroy(self):
+        """Kill every helper actor this rank can name (idempotent)."""
+        for name in ([_actor_name(self.name)]
+                     + [_actor_name(self.name, f"_mbx{r}")
+                        for r in range(self.world)]):
+            try:
+                ray_tpu.kill(ray_tpu.get_actor(name))
+            except Exception:
+                pass

@@ -1,0 +1,77 @@
+"""Backend registry + auto-selection policy.
+
+Backends are factories taking a ``GroupContext`` and returning an object
+with the op surface (allreduce/allgather/broadcast/reducescatter/
+barrier). Third parties can plug in via ``register_backend`` — e.g. a
+future RDMA or grpc transport — without touching the API layer.
+
+``"auto"`` picks per call site:
+
+- tiny worlds (≤ 2) and small payloads (< 64 KiB) → ``gather`` — one
+  coordinator RTT beats 2(N−1) ring hops when latency dominates;
+- large payloads spanning nodes → ``hier`` — only node leaders pay the
+  inter-node (DCN-analog) price;
+- large payloads on one node → ``ring`` — bandwidth-optimal, no
+  single-process fan-in.
+
+Selection inputs must be identical on every rank: world size and
+topology always are; payload bytes are used only for ops whose payload
+shape is required to match across ranks (allreduce/reducescatter).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+#: Payloads below this take the single-RTT coordinator path under "auto".
+SMALL_PAYLOAD_BYTES = 64 * 1024
+
+_BACKENDS: Dict[str, Callable] = {}
+
+
+def register_backend(name: str, factory: Callable) -> None:
+    _BACKENDS[name] = factory
+
+
+def get_backend_factory(name: str) -> Callable:
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown collective backend {name!r}; "
+            f"available: {sorted(_BACKENDS)}") from None
+
+
+def available_backends() -> List[str]:
+    return sorted(_BACKENDS)
+
+
+def _register_defaults() -> None:
+    from ray_tpu.collective.gather import GatherBackend
+    from ray_tpu.collective.hier import HierBackend
+    from ray_tpu.collective.ring import RingBackend
+
+    register_backend("gather", GatherBackend)
+    register_backend("ring", RingBackend)
+    register_backend("hier", HierBackend)
+
+
+_register_defaults()
+
+
+def select_backend(op: str, world_size: int, topology,
+                   payload_bytes: Optional[int] = None) -> str:
+    """Resolve "auto" to a concrete backend name for one op call."""
+    if world_size <= 2:
+        return "gather"
+    if op in ("allreduce", "reducescatter"):
+        if payload_bytes is not None and payload_bytes < SMALL_PAYLOAD_BYTES:
+            return "gather"
+        if topology is not None and topology.multi_node:
+            return "hier"
+        return "ring"
+    if op == "allgather":
+        return "ring"
+    if op == "broadcast":
+        return "ring"          # tree broadcast: log N depth, no fan-in
+    return "gather"            # barrier and anything latency-bound

@@ -267,6 +267,26 @@ class _ReadPin:
     def __buffer__(self, flags):
         return memoryview(self._view)
 
+    @property
+    def __array_interface__(self):
+        # Python < 3.12 has no PEP 688, so memoryview(pin) cannot export
+        # from this object directly. numpy can: np.asarray(pin) reads this
+        # interface and keeps the pin as the array's base, so slices of
+        # memoryview(np.asarray(pin)) carry the same keeps-the-pin chain.
+        import numpy as np
+
+        base = np.frombuffer(self._view, dtype=np.uint8)
+        ptr, _ = base.__array_interface__["data"]
+        return {"shape": base.shape, "typestr": "|u1",
+                "data": (ptr, True), "version": 3}
+
+    def buffer(self) -> memoryview:
+        """A memoryview whose derived slices keep THIS pin alive (works on
+        interpreters without PEP 688 __buffer__ support)."""
+        import numpy as np
+
+        return memoryview(np.asarray(self))
+
     def __del__(self):
         self._view = None
         try:
@@ -899,9 +919,9 @@ class Runtime:
                 pin, lambda r, oid=oid: (
                     self._pinned.pop(oid, None)
                     if self._pinned.get(oid) is r else None))
-        # values deserialize out of memoryview(pin): their buffer chains
+        # values deserialize out of the pin's buffer: their buffer chains
         # keep the pin (and thus the store region) alive
-        value = serialization.read_from(memoryview(pin))
+        value = serialization.read_from(pin.buffer())
         if isinstance(value, serialization.SerializedException):
             raise value.to_exception()
         return value

@@ -64,6 +64,36 @@ class _DDPPOWorker:
                                        **{k: float(v)
                                           for k, v in aux.items()}}
 
+    def init_collective(self, rank: int, world: int, backend: str,
+                        group: str = "ddppo_grads") -> bool:
+        """Join the fleet-wide gradient-allreduce group (the reference's
+        torch.distributed process group, as a ray_tpu.collective host
+        group — gradients cross rank-to-rank, not through the driver)."""
+        from ray_tpu import collective as col
+
+        self._col_group = group
+        self._col_rank = rank
+        self._col_world = world
+        col.init_collective_group(world, rank, group, backend=backend)
+        return True
+
+    def grad_reduced(self, params):
+        """One minibatch gradient, allreduced across the fleet in place.
+
+        Returns (mean_grads, aux) on rank 0 and (None, aux) elsewhere —
+        the driver applies rank 0's result, so the full gradient tree
+        crosses the driver wire once instead of num_workers times."""
+        from ray_tpu import collective as col
+
+        grads, aux = self.grad(params)
+        total = col.allreduce(grads, self._col_group)
+        if self._col_rank != 0:
+            return None, aux
+        import jax
+
+        world = self._col_world
+        return jax.tree_util.tree_map(lambda g: g / world, total), aux
+
     def episode_stats(self):
         return self.inner.episode_stats()
 
@@ -90,6 +120,12 @@ class DDPPOConfig:
     network: str = "auto"
     cnn_hidden: int = 512
     seed: int = 0
+    # Host-collective gradient exchange (ray_tpu.collective backend name:
+    # "auto"/"gather"/"ring"/"hier"). None keeps the legacy star topology
+    # (driver-side mean). With a backend set, gradients allreduce
+    # rank-to-rank and only rank 0 ships the mean to the driver —
+    # driver ingress drops from num_workers x |grads| to 1 x |grads|.
+    collective_backend: Optional[str] = None
 
 
 class DDPPOTrainer(Algorithm):
@@ -119,6 +155,11 @@ class DDPPOTrainer(Algorithm):
                                 cfg.env_config, cfg_dict,
                                 cfg.obs_connectors)
             for i in range(cfg.num_rollout_workers)]
+        if cfg.collective_backend:
+            world = cfg.num_rollout_workers
+            ray_tpu.get([w.init_collective.remote(i, world,
+                                                  cfg.collective_backend)
+                         for i, w in enumerate(self.workers)], timeout=240)
         self.timesteps = 0
         self._apply = jax.jit(self._make_apply())
 
@@ -146,9 +187,16 @@ class DDPPOTrainer(Algorithm):
 
         aux = {}
         for _ in range(cfg.num_sgd_iter):
-            results = ray_tpu.get([w.grad.remote(params_host)
-                                   for w in self.workers])
-            grads_list = [g for g, _ in results]
+            if cfg.collective_backend:
+                # fleet-side allreduce: driver receives ONE gradient tree
+                # (rank 0's mean) instead of num_workers of them
+                results = ray_tpu.get([w.grad_reduced.remote(params_host)
+                                       for w in self.workers])
+                grads_list = [results[0][0]]
+            else:
+                results = ray_tpu.get([w.grad.remote(params_host)
+                                       for w in self.workers])
+                grads_list = [g for g, _ in results]
             aux = results[0][1]
             self.params, self.opt_state = self._apply(
                 self.params, self.opt_state, grads_list)

@@ -98,6 +98,26 @@ class TrainWorker:
             self.ctx.world_size = world_size
         return True
 
+    def init_host_collective(self, group_name: str = "train",
+                             backend: str = "auto",
+                             timeout_s: float = 60.0) -> bool:
+        """Join the gang-wide host collective group (ray_tpu.collective):
+        rank/world come from the gang, so a user loop can immediately
+        call collective.allreduce/barrier for host-side exchanges
+        (metric reduction, data-pipeline shuffles) without its own
+        rendezvous. Device collectives stay inside the jitted step."""
+        from ray_tpu import collective as col
+
+        col.init_collective_group(self.world_size, self.rank, group_name,
+                                  backend=backend, timeout_s=timeout_s)
+        return True
+
+    def destroy_host_collective(self, group_name: str = "train") -> bool:
+        from ray_tpu import collective as col
+
+        col.destroy_collective_group(group_name)
+        return True
+
     def host_info(self) -> dict:
         import socket
 
@@ -155,6 +175,22 @@ class WorkerGroup:
         refs = [getattr(w, method).remote(*args, **kwargs)
                 for w in self.workers]
         return ray_tpu.get(refs)
+
+    def init_host_collective(self, group_name: str = "train",
+                             backend: str = "auto",
+                             timeout_s: float = 60.0):
+        """Bring up a ray_tpu.collective group spanning the gang (one
+        rank per worker) for host-side exchanges outside the jitted
+        step. Re-run after an elastic resize to rebuild the group on
+        the new topology (destroy first — group membership is static)."""
+        return self.broadcast("init_host_collective", group_name=group_name,
+                              backend=backend, timeout_s=timeout_s)
+
+    def destroy_host_collective(self, group_name: str = "train"):
+        # one worker reaps the named helper actors; the rest only drop
+        # their local clients (destroy is idempotent across ranks)
+        return self.broadcast("destroy_host_collective",
+                              group_name=group_name)
 
     # ---- elasticity (ref: worker_group.py:318 remove_workers /
     #      :333 add_workers; BackendExecutor resizes then re-ranks) ------

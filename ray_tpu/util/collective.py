@@ -1,204 +1,35 @@
-"""Host-level collective ops among actors/tasks.
+"""Host-level collective ops among actors/tasks — compatibility shim.
 
-Reference: python/ray/util/collective/collective.py (GroupManager:40,
-init_collective_group:120, allreduce:258, broadcast:373, allgather:423,
-reducescatter:472, barrier:298) with NCCL/Gloo backends.
+The implementation moved to the ``ray_tpu.collective`` package
+(topology-aware backends: legacy ``gather`` coordinator, bandwidth-
+optimal ``ring``, hierarchical ``hier``; async variants; member-failure
+detection). This module re-exports the same surface the reference's
+``python/ray/util/collective/collective.py`` offered so existing
+callers keep working unchanged; new code should import
+``ray_tpu.collective`` directly.
 
 TPU-native position (SURVEY.md §5.8): *device* collectives live inside
 jitted programs (psum/all_gather over ICI emitted by XLA — see
-ray_tpu.parallel), so this module only covers the reference's HOST-side
-use case: exchanging CPU arrays between actors (rollout fleets, data
-pipelines). Backend: a per-group coordinator actor doing gather+broadcast —
-O(world) through the object store, no extra native deps.
+ray_tpu.parallel), so this surface only covers the HOST-side use case:
+exchanging CPU arrays between actors (rollout fleets, data pipelines).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from ray_tpu.collective import (CollectiveError, CollectiveTimeoutError,
+                                allgather, allgather_async, allreduce,
+                                allreduce_async, barrier, barrier_async,
+                                broadcast, broadcast_async,
+                                destroy_collective_group,
+                                get_collective_group_size, get_rank,
+                                init_collective_group, reducescatter,
+                                reducescatter_async, transfer_stats)
 
-import numpy as np
-
-import ray_tpu
-
-#: Keyed by (calling actor id, group name), NOT group name alone: the
-#: reference keys per-process because one actor == one process there —
-#: with lane-packed fractional-CPU actors sharing a worker process,
-#: per-process group state would let rank N's init clobber rank M's
-#: (their allreduce then deadlocks waiting for ranks that can never
-#: arrive — found by the suite's collective test once its members
-#: became lane-packed).
-_groups: Dict[tuple, "_GroupClient"] = {}
-
-
-def _ctx() -> Optional[str]:
-    try:
-        return ray_tpu.get_runtime_context().get_actor_id()
-    except Exception:
-        return None
-
-
-def _on_actor_teardown(actor_id_hex: str) -> None:
-    """Lane actors die without their process dying: drop their group
-    clients so a churning fleet cannot grow _groups unboundedly."""
-    for key in [k for k in _groups if k[0] == actor_id_hex]:
-        _groups.pop(key, None)
-
-
-from ray_tpu.core.runtime import actor_teardown_hooks as _hooks  # noqa: E402
-
-_hooks.append(_on_actor_teardown)
-
-
-@ray_tpu.remote
-class _Coordinator:
-    def __init__(self, world_size: int):
-        import asyncio
-
-        self.world = world_size
-        self.rounds: Dict[tuple, dict] = {}
-        self.cv = asyncio.Condition()
-
-    async def exchange(self, op: str, seq: int, rank: int, data):
-        """All ranks call with their contribution; returns the combined
-        result once everyone arrived."""
-        import asyncio
-
-        key = (op, seq)
-        async with self.cv:
-            slot = self.rounds.setdefault(key, {"parts": {}, "result": None})
-            slot["parts"][rank] = data
-            if len(slot["parts"]) == self.world:
-                parts = [slot["parts"][r] for r in range(self.world)]
-                if op == "allreduce_sum":
-                    out = parts[0]
-                    for p in parts[1:]:
-                        out = out + p
-                    slot["result"] = [out] * self.world
-                elif op == "allgather":
-                    slot["result"] = [list(parts)] * self.world
-                elif op == "barrier":
-                    slot["result"] = [True] * self.world
-                elif op == "broadcast":
-                    src = next(p for p in parts if p is not None)
-                    slot["result"] = [src] * self.world
-                elif op == "reducescatter":
-                    total = parts[0]
-                    for p in parts[1:]:
-                        total = total + p
-                    chunks = np.array_split(total, self.world)
-                    slot["result"] = chunks
-                else:
-                    raise ValueError(op)
-                self.cv.notify_all()
-            else:
-                while self.rounds[key]["result"] is None:
-                    await self.cv.wait()
-        result = self.rounds[key]["result"][rank]
-        slot["parts"].pop(rank, None)
-        if not slot["parts"]:
-            self.rounds.pop(key, None)
-        return result
-
-
-class _GroupClient:
-    def __init__(self, name: str, world_size: int, rank: int):
-        self.name = name
-        self.world = world_size
-        self.rank = rank
-        self.seq = 0
-        actor_name = f"_collective_{name}"
-        if rank == 0:
-            try:
-                self.coord = _Coordinator.options(
-                    name=actor_name, max_concurrency=max(world_size * 2, 4),
-                    num_cpus=0).remote(world_size)
-            except ValueError:
-                self.coord = ray_tpu.get_actor(actor_name)
-        else:
-            import time
-
-            deadline = time.time() + 30
-            while True:
-                try:
-                    self.coord = ray_tpu.get_actor(actor_name)
-                    break
-                except ValueError:
-                    if time.time() > deadline:
-                        raise
-                    time.sleep(0.1)
-
-    def _x(self, op: str, data):
-        self.seq += 1
-        return ray_tpu.get(self.coord.exchange.remote(op, self.seq,
-                                                      self.rank, data))
-
-
-def init_collective_group(world_size: int, rank: int,
-                          group_name: str = "default") -> None:
-    """ref: collective.py:120."""
-    _groups[(_ctx(), group_name)] = _GroupClient(group_name, world_size,
-                                                 rank)
-
-
-def destroy_collective_group(group_name: str = "default") -> None:
-    g = _groups.pop((_ctx(), group_name), None)
-    if g and g.rank == 0:
-        try:
-            ray_tpu.kill(g.coord)
-        except Exception:
-            pass
-
-
-def _group(name: str) -> _GroupClient:
-    key = (_ctx(), name)
-    g = _groups.get(key)
-    if g is not None:
-        return g
-    # Helper threads an actor spawns itself start with a fresh context
-    # (no actor id). If exactly ONE client for this group name lives in
-    # the process, that use is unambiguous — honor it (the per-process
-    # reference semantics). Multiple same-name clients (lane-packed
-    # ranks) make a context-less call genuinely ambiguous.
-    candidates = [g for (a, n), g in _groups.items() if n == name]
-    if len(candidates) == 1:
-        return candidates[0]
-    if candidates:
-        raise RuntimeError(
-            f"collective group {name!r}: ambiguous caller — "
-            f"{len(candidates)} lane-packed actors initialized this "
-            "group in one process, and this call carries no actor "
-            "context (e.g. a self-spawned thread). Call from an actor "
-            "method, or propagate contextvars into the thread")
-    raise RuntimeError(f"collective group {name!r} not initialized")
-
-
-def allreduce(tensor: np.ndarray, group_name: str = "default") -> np.ndarray:
-    """SUM allreduce (ref: collective.py:258)."""
-    return np.asarray(_group(group_name)._x("allreduce_sum", np.asarray(tensor)))
-
-
-def allgather(tensor: np.ndarray, group_name: str = "default") -> List[np.ndarray]:
-    return _group(group_name)._x("allgather", np.asarray(tensor))
-
-
-def broadcast(tensor: Optional[np.ndarray], src_rank: int = 0,
-              group_name: str = "default") -> np.ndarray:
-    g = _group(group_name)
-    data = np.asarray(tensor) if g.rank == src_rank else None
-    return np.asarray(g._x("broadcast", data))
-
-
-def reducescatter(tensor: np.ndarray, group_name: str = "default") -> np.ndarray:
-    return np.asarray(_group(group_name)._x("reducescatter", np.asarray(tensor)))
-
-
-def barrier(group_name: str = "default") -> None:
-    _group(group_name)._x("barrier", None)
-
-
-def get_rank(group_name: str = "default") -> int:
-    return _group(group_name).rank
-
-
-def get_collective_group_size(group_name: str = "default") -> int:
-    return _group(group_name).world
+__all__ = [
+    "init_collective_group", "destroy_collective_group",
+    "allreduce", "allgather", "broadcast", "reducescatter", "barrier",
+    "allreduce_async", "allgather_async", "broadcast_async",
+    "reducescatter_async", "barrier_async",
+    "get_rank", "get_collective_group_size", "transfer_stats",
+    "CollectiveError", "CollectiveTimeoutError",
+]

@@ -88,6 +88,72 @@ def project_tokens_per_sec_per_chip(n_params, n_layers, seq, d_model,
     }
 
 
+def _pb_fields(buf):
+    """Minimal protobuf wire-format walk: yields (field_no, wire_type, value)."""
+    i = 0
+    while i < len(buf):
+        tag, s = buf[i], 0
+        x = 0
+        while True:
+            b = buf[i]
+            i += 1
+            x |= (b & 0x7F) << s
+            if not b & 0x80:
+                break
+            s += 7
+        fn, wt = x >> 3, x & 7
+        if wt == 0:
+            v, s = 0, 0
+            while True:
+                b = buf[i]
+                i += 1
+                v |= (b & 0x7F) << s
+                if not b & 0x80:
+                    break
+                s += 7
+        elif wt == 2:
+            ln, s = 0, 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << s
+                if not b & 0x80:
+                    break
+                s += 7
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        elif wt == 1:
+            v = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def peak_hbm_from_hlo_proto(proto_bytes: bytes) -> int:
+    """Peak per-device memory from an HloProto's buffer assignment — the
+    number `CompiledMemoryStats.peak_memory_in_bytes` used to report
+    before newer jaxlib dropped the field. Every buffer allocation is
+    held for the whole execution (parameters, outputs, constants, and
+    the temp allocation, whose size the compiler already packed down to
+    the heap-simulated liveness peak), so the peak is their sum."""
+    ba = None
+    for fn, wt, v in _pb_fields(bytes(proto_bytes)):
+        if fn == 3 and wt == 2:          # HloProto.buffer_assignment
+            ba = v
+    if ba is None:
+        raise ValueError("HloProto has no buffer_assignment")
+    peak = 0
+    for fn, wt, v in _pb_fields(ba):
+        if fn == 3 and wt == 2:          # BufferAllocationProto: size=2
+            f = dict((a, c) for a, _, c in _pb_fields(v))
+            peak += f.get(2, 0)
+    return peak
+
+
 def compile_case(preset: str, chip: str, mesh_axes: dict, rules_name: str,
                  batch: int, seq: int, mu_dtype=None):
     """AOT-compile the train step for `preset` on `chip`'s topology.
@@ -138,7 +204,22 @@ def compile_case(preset: str, chip: str, mesh_axes: dict, rules_name: str,
         opt, mesh, rules, state_sh, batch_shapes=bshape)
     compiled = step.lower(state_abs, batch_abs).compile()
     mem = compiled.memory_analysis()
-    peak = mem.peak_memory_in_bytes
+    peak = getattr(mem, "peak_memory_in_bytes", None)
+    peak_is_upper_bound = False
+    if peak is None:
+        # newer jaxlib drops peak_memory_in_bytes from CompiledMemoryStats;
+        # recompute it from the buffer assignment when the HloProto ships
+        # one, else fall back to the component sum — an upper bound, since
+        # it cannot see liveness (temps that never coexist all count).
+        pb = bytes(mem.serialized_hlo_proto)
+        if pb:
+            peak = peak_hbm_from_hlo_proto(pb)
+        else:
+            peak = (mem.argument_size_in_bytes + mem.output_size_in_bytes
+                    + mem.temp_size_in_bytes
+                    + mem.generated_code_size_in_bytes
+                    - mem.alias_size_in_bytes)
+            peak_is_upper_bound = True
 
     n_params = llama.num_params(cfg)
     per_dev_tokens = batch * seq // c["n_devices"]
@@ -156,6 +237,7 @@ def compile_case(preset: str, chip: str, mesh_axes: dict, rules_name: str,
                                 else "(f32)"),
         "peak_hbm_bytes_per_device": int(peak),
         "peak_hbm_gb": round(peak / 1e9, 2),
+        "peak_is_upper_bound": peak_is_upper_bound,
         "hbm_limit_gb": round(c["hbm_bytes"] / 1e9, 1),
         "fits": bool(peak <= c["hbm_bytes"]),
         **project_tokens_per_sec_per_chip(

@@ -13,12 +13,23 @@ import os
 # Must happen before anything imports jax (including transitively).
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""       # disable axon sitecustomize hook
+# libtpu retries the GCP instance-metadata server for minutes when it is
+# unreachable (sleep loops that even swallow SIGINT) — any collection-time
+# TPU probe (test_model_scale's AOT-compiler guard) would hang the whole
+# suite. Off-GCP there is nothing to fetch; skip the queries outright.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`) — sweeps, soak runs")
 
 
 @pytest.fixture(scope="function")

@@ -18,13 +18,25 @@ def _tpu_compiler_available():
 
         topologies.get_topology_desc(platform="tpu",
                                      topology_name="v5e:2x4")
-        return True
     except Exception:
         return False
+    # The assertion below is about the compiler's authoritative peak-HBM
+    # number. Some jaxlib builds drop CompiledMemoryStats.peak_memory_in_bytes
+    # AND ship an empty serialized_hlo_proto from TPU AOT compiles; the
+    # only fallback then is a liveness-blind upper bound, which cannot
+    # honestly decide a 16 GB budget — skip rather than guess.
+    try:
+        from jax._src.lib import xla_extension
+
+        return hasattr(xla_extension.CompiledMemoryStats,
+                       "peak_memory_in_bytes")
+    except Exception:
+        return True
 
 
 @pytest.mark.skipif(not _tpu_compiler_available(),
-                    reason="libtpu AOT compiler not available")
+                    reason="libtpu AOT compiler with authoritative peak-HBM "
+                           "stats not available")
 def test_llama7b_fsdp_fits_v5e8_hbm():
     import os
     import sys
