@@ -53,6 +53,14 @@ class Config:
     object_spill_threshold: float = 0.8          # spill when usage crosses this
     object_spill_low_water: float = 0.5          # ...down to this fraction
     object_spill_dir: str = ""                   # default: <session>/spill
+    # --- data streaming executor (ray_tpu/data/execution/) ------------------
+    # Share of object_store_memory the executor may hold in unconsumed
+    # operator outputs (ResourceManager budget; split evenly across the
+    # pipeline's budgetable operators). Also bounds the fused path's
+    # generator byte backpressure.
+    data_execution_budget_fraction: float = 0.25
+    # Max concurrent tasks a single physical operator keeps in flight.
+    data_execution_max_tasks_per_op: int = 4
     # --- scheduler / raylet -------------------------------------------------
     worker_lease_timeout_s: float = 30.0
     # -1 = auto: min(node CPU total, 2) workers spawn at node start (ref:

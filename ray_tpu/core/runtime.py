@@ -575,6 +575,20 @@ class Runtime:
                 e = self.directory[oid] = _ObjectEntry()
             return e
 
+    def object_nbytes(self, ref: ObjectRef) -> Optional[int]:
+        """Stored size of a READY object known to this runtime, else
+        None — no fetch, no RPC (the data streaming executor budgets
+        queued operator outputs from owner-side directory sizes)."""
+        with self._dir_lock:
+            e = self.directory.get(ref.id)
+        if e is None or e.state != "ready":
+            return None
+        if e.size:
+            return int(e.size)
+        if e.inline is not None:
+            return len(e.inline)
+        return None
+
     def put(self, value: Any, _pin: bool = True) -> ObjectRef:
         """ref: CoreWorker::Put core_worker.cc:1119 — plus the HBM tier:
         a device array skips serialization entirely (no D2H, no shm

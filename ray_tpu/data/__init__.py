@@ -1,10 +1,18 @@
 """ray_tpu.data: block-based datasets with streaming execution.
 
 Reference: python/ray/data/ — Dataset as a lazy logical plan over blocks
-flowing as object refs (SURVEY.md §1 L7), executed with bounded in-flight
-tasks (the backpressure idea of _internal/execution/streaming_executor.py:49
-reduced to a windowed pull loop), and train ingest via per-rank split
-iterators (_internal/iterator/stream_split_iterator.py).
+flowing as object refs (SURVEY.md §1 L7), and train ingest via per-rank
+split iterators (_internal/iterator/stream_split_iterator.py).
+
+Execution lives in `ray_tpu.data.execution`: a physical operator graph
+(InputDataBuffer -> per-op map operators -> optional OutputSplitter)
+scheduled task-by-task by a StreamingExecutor whose
+select_operator_to_run policy keeps each operator's unconsumed output
+under a store-derived byte budget (the reference's
+_internal/execution/streaming_executor_state.py:376). Multi-op chains
+pipeline across operators — a slow stage rate-limits its producers;
+single-op chains default to the legacy `fused` windowed-generator path.
+See execution/__init__.py for the operator/budget/policy details.
 
 Blocks are dict-of-numpy (tabular) or Python lists (simple); they live in
 the shared-memory object store and move zero-copy into consumers. The TPU
@@ -20,7 +28,7 @@ from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
                                   read_bigquery, read_mongo,
                                   read_parquet, read_sql, read_text,
                                   read_tfrecords, read_webdataset, write_sql)
-from ray_tpu.data import aggregate, preprocessors
+from ray_tpu.data import aggregate, execution, preprocessors
 from ray_tpu.data.grouped import GroupedData
 
 # `range` shadows the builtin deliberately, matching the reference API
@@ -33,5 +41,5 @@ __all__ = [
     "read_json", "read_parquet", "read_sql", "read_text", "read_tfrecords",
     "read_mongo", "read_bigquery",
     "read_webdataset", "write_sql", "aggregate",
-    "preprocessors", "GroupedData",
+    "execution", "preprocessors", "GroupedData",
 ]

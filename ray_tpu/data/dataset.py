@@ -1,12 +1,17 @@
-"""Dataset: lazy plan over blocks, windowed streaming execution.
+"""Dataset: lazy plan over blocks, streaming per-operator execution.
 
 Reference map (python/ray/data/):
   Dataset/logical plan        -> Dataset._ops list (dataset.py:385 map_batches)
-  StreamingExecutor           -> _StreamIterator windowed task pool
-                                 (streaming_executor.py:49, backpressure via
-                                 a max-in-flight window instead of object
-                                 store budgets)
-  DataIterator / train ingest -> DataIterator.iter_batches / split()
+  StreamingExecutor           -> ray_tpu.data.execution: a physical operator
+                                 graph scheduled task-by-task against output
+                                 byte budgets (streaming_executor_state.py:376
+                                 select_operator_to_run); multi-op chains
+                                 route through it, single-op chains keep the
+                                 legacy fused windowed-generator path (the
+                                 `fused` policy)
+  DataIterator / train ingest -> DataIterator.iter_batches / split();
+                                 per-host shard feeds via iter_split()
+                                 (OutputSplitter over ONE executor run)
   datasources                 -> read_parquet/csv/json via pyarrow
 """
 
@@ -31,6 +36,27 @@ def _block_rows(b: Block) -> int:
     if isinstance(b, dict):
         return len(next(iter(b.values()))) if b else 0
     return len(b)
+
+
+def _block_nbytes(b: Block) -> int:
+    """Approximate in-memory bytes of a block — the unit the streaming
+    executor's ResourceManager budgets (ref: BlockMetadata.size_bytes).
+    Array columns are exact; object columns and list blocks estimate via
+    per-item getsizeof."""
+    import sys
+
+    if isinstance(b, dict):
+        total = 0
+        for v in b.values():
+            a = np.asarray(v)
+            if a.dtype == object:
+                total += int(sum(sys.getsizeof(x) for x in a.reshape(-1)))
+            else:
+                total += int(a.nbytes)
+        return total
+    if isinstance(b, list):
+        return int(sum(sys.getsizeof(x) for x in b))
+    return int(sys.getsizeof(b))
 
 
 def _block_slice(b: Block, lo: int, hi: int) -> Block:
@@ -266,44 +292,34 @@ class Dataset:
 
     def _map_batches_actors(self, fn_cls, batch_size, strategy,
                             ctor_args) -> "Dataset":
-        """Dispatch blocks over a pool of stateful map actors; blocks
-        travel as refs (never through the driver); actors are reaped
-        after the last block lands."""
-        import ray_tpu
+        """Dispatch blocks over a pool of stateful map actors via the
+        streaming executor's ActorPoolMapOperator; blocks travel as refs
+        (never through the driver), dispatch/harvest ride the ordered
+        ActorPool, and the pool is reaped at executor shutdown. Output
+        block order matches input order."""
+        from ray_tpu.data.execution import (ActorPoolMapOperator,
+                                            InputDataBuffer,
+                                            ResourceManager,
+                                            StreamingExecutor, get_context)
 
         if not isinstance(fn_cls, type):
             raise TypeError(
                 "compute=ActorPoolStrategy(...) needs a callable CLASS "
                 "(stateful UDF with __call__), got a function")
+        if not self._block_refs:
+            return Dataset([], [])
+        ctx = get_context()
         # pending lazy ops fuse INTO the actor (one hop per block, no
         # intermediate materialize through the store)
-        pending_ops = self._ops
-
-        @ray_tpu.remote
-        class _MapWorker:
-            def __init__(self, cls, args, ops):
-                self.fn = cls(*args)
-                self.ops = ops
-
-            def apply(self, block, bs):
-                block = _transform_block(block, self.ops)
-                return _apply_rebatched(self.fn, block, bs)
-
         n_actors = max(1, min(strategy.size, len(self._block_refs)))
-        pool = [_MapWorker.options(
-                    num_cpus=strategy.num_cpus_per_actor).remote(
-                    fn_cls, tuple(ctor_args), pending_ops)
-                for _ in builtins.range(n_actors)]
-        try:
-            refs = [pool[i % n_actors].apply.remote(ref, batch_size)
-                    for i, ref in enumerate(self._block_refs)]
-            ray_tpu.wait(refs, num_returns=len(refs))
-        finally:
-            for a in pool:
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
+        inp = InputDataBuffer(self._block_refs)
+        op = ActorPoolMapOperator(
+            "map_batches(actors)", fn_cls, tuple(ctor_args), n_actors,
+            strategy.num_cpus_per_actor, batch_size,
+            fused_ops=self._ops, input_op=inp)
+        rm = ResourceManager([inp, op],
+                             per_op_budget_bytes=ctx.per_op_budget_bytes)
+        refs = StreamingExecutor([inp, op], rm).execute_to_refs()
         return Dataset(refs, [])
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
@@ -334,18 +350,60 @@ class Dataset:
     def materialize(self) -> "Dataset":
         import ray_tpu
 
+        from ray_tpu.data.execution import build_pipeline, get_context
+
+        if (self._ops and self._block_refs and
+                get_context().resolve_policy(None, len(self._ops))
+                == "streaming"):
+            # budget-aware drain: transformed blocks land in the store in
+            # source order; unconsumed bytes stay under the executor budget
+            refs = build_pipeline(self._block_refs,
+                                  self._ops).execute_to_refs()
+            return Dataset(refs, [])
         refs = self._executed_refs()
         ray_tpu.wait(refs, num_returns=len(refs))
         return Dataset(refs, [])
 
-    def _iter_blocks(self) -> Iterator[Block]:
-        """Streaming pull: _WINDOW generator tasks each transform a
-        strided shard of the blocks, yielding results block-at-a-time;
-        consumer-coupled generator backpressure keeps every executor at
-        most _STREAM_AHEAD blocks ahead of consumption, so memory is
-        bounded regardless of dataset size (ref: streaming generators
-        feeding streaming_executor_state.py's backpressure loop).
-        Round-robin over strided shards restores original block order."""
+    def iter_split(self, n: int) -> List["Iterator[Block]"]:
+        """n in-process block iterators fed by ONE streaming-executor run
+        (OutputSplitter sink, round-robin bundles) — the per-host shape of
+        train ingest: one pipeline per host feeding that host's local
+        consumers, instead of n disjoint pipelines (ref:
+        output_splitter.py behind streaming_split). Consumers should be
+        drained roughly together; a shard nobody reads parks its bundles
+        in its queue. For cross-process per-rank ingest, use
+        streaming_split() — its iterators pickle."""
+        import ray_tpu
+
+        from ray_tpu.data.execution import build_pipeline
+
+        if not self._block_refs:
+            return [iter(()) for _ in builtins.range(n)]
+        executor = build_pipeline(self._block_refs, self._ops, split=n)
+
+        def _blocks(shard):
+            for bundle in shard:
+                yield ray_tpu.get(bundle.block_ref)
+
+        return [_blocks(s) for s in executor.execute_split(n)]
+
+    def _iter_blocks(self, policy: Optional[str] = None) -> Iterator[Block]:
+        """Streaming pull through one of two physical paths.
+
+        `streaming` (default for chains of 2+ ops): the per-operator
+        executor in ray_tpu.data.execution — every logical op becomes an
+        independently scheduled operator, and select_operator_to_run
+        keeps each operator's unconsumed output under a store-derived
+        byte budget, so a slow late stage throttles the early stages
+        (ref: streaming_executor_state.py:376).
+
+        `fused` (default for single-op chains): _WINDOW generator tasks
+        each transform a strided shard of the blocks with the whole
+        chain fused, consumer-coupled generator backpressure keeps every
+        executor at most _STREAM_AHEAD blocks ahead of consumption
+        (ref: streaming generators). Round-robin over strided shards
+        restores original block order. Both paths yield identical
+        blocks in identical order."""
         import ray_tpu
 
         ops = self._ops
@@ -355,6 +413,12 @@ class Dataset:
             return
         refs = self._block_refs
         if not refs:
+            return
+        from ray_tpu.data.execution import build_pipeline, get_context
+
+        if get_context().resolve_policy(policy, len(ops)) == "streaming":
+            for bundle in build_pipeline(refs, ops).execute():
+                yield ray_tpu.get(bundle.block_ref)
             return
         w = min(_WINDOW, len(refs))
         # Admission by object-store byte budget, not just block count
@@ -367,7 +431,9 @@ class Dataset:
         r = _rt.current_runtime_or_none()
         store_budget = (r.cfg.object_store_memory if r is not None
                         else 2 << 30)
-        bp_bytes = max(1 << 20, int(store_budget * _ADMISSION_FRACTION / w))
+        frac = (r.cfg.data_execution_budget_fraction if r is not None
+                else _ADMISSION_FRACTION)
+        bp_bytes = max(1 << 20, int(store_budget * frac / w))
 
         @ray_tpu.remote(num_returns="streaming",
                         generator_backpressure=_STREAM_AHEAD,
@@ -1000,7 +1066,8 @@ class Dataset:
 
 class DataIterator:
     """Picklable per-rank iterator: holds block refs + pending ops and pulls
-    through the windowed executor in the consumer process
+    through `_iter_blocks` in the consumer process — i.e. multi-op train
+    ingest rides the streaming executor on each rank automatically
     (ref: DataIterator, iterator.py; train ingest session.py:901)."""
 
     def __init__(self, block_refs: List[Any], ops: List[tuple]):

@@ -20,12 +20,28 @@ bandwidth order above (cf. the scaling-book recipe).
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "tp")
+
+
+def has_physical_topology(devices: Sequence) -> bool:
+    """Capability probe: do these devices expose a real ICI topology?
+
+    TPU devices carry `coords` (their position in the physical torus);
+    CPU/emulated devices don't, and for them any positional layout is as
+    good as any other. This is the ONLY condition under which falling
+    back from mesh_utils to a positional reshape is safe — on a real
+    torus a reshape would scatter inner (ICI-hungry) axes across
+    arbitrary links."""
+    return bool(devices) and all(
+        getattr(d, "coords", None) is not None for d in devices)
 
 
 @dataclass(frozen=True)
@@ -84,7 +100,16 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None,
         dev_array = mesh_utils.create_device_mesh(
             shape, devices=list(devices),
             allow_split_physical_axes=allow_split_physical)
-    except (ValueError, AssertionError, NotImplementedError):
+    except (ValueError, AssertionError, NotImplementedError) as e:
+        if has_physical_topology(devices):
+            # real ICI topology mis-described (bad axis sizes, impossible
+            # split): silently flattening would put per-layer collectives
+            # on arbitrary links — surface the error instead
+            raise
+        logger.info(
+            "mesh_utils.create_device_mesh(%s) failed on topology-less "
+            "devices (%s: %s); using positional reshape — layout is "
+            "arbitrary but harmless without ICI", shape, type(e).__name__, e)
         dev_array = np.asarray(list(devices)).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
 
@@ -158,7 +183,12 @@ def build_hybrid_mesh(spec: MeshSpec, dcn: DCNSpec,
             allow_split_physical_axes=True)
     else:
         # no slice_index metadata (CPU dryrun / emulation): emulate —
-        # slice id becomes the outermost factor of each DCN axis
+        # slice id becomes the outermost factor of each DCN axis. Size
+        # mismatches between the DCN spec and the device count still
+        # raise (reshape below), never silently flatten.
+        logger.info(
+            "build_hybrid_mesh: devices carry no slice_index (emulated "
+            "topology); emulating %d slices positionally", n_slices)
         combined = tuple(d * i for d, i in zip(dcn_shape, ici_shape))
         arr = np.asarray(list(devices)).reshape(
             (n_slices,) + ici_shape)          # [slice, dp, pp, fsdp, sp, tp]

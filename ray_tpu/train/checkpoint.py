@@ -188,7 +188,10 @@ class Checkpoint:
                 # process (e.g. the driver inspecting a result).
                 sds = jax.sharding.SingleDeviceSharding(
                     jax.local_devices()[0])
-                im = ckptr.metadata(arrays_dir).item_metadata
+                md = ckptr.metadata(arrays_dir)
+                # orbax drift: newer versions return the item tree
+                # directly instead of a CheckpointMetadata wrapper
+                im = getattr(md, "item_metadata", md)
                 meta = getattr(im, "tree", im)
                 target = {k: jax.ShapeDtypeStruct(m.shape, m.dtype,
                                                   sharding=sds)
