@@ -93,7 +93,9 @@ def init(address: Optional[str] = None, *,
                                         else (os.cpu_count() or 1)))
             chips = num_tpus if num_tpus is not None else detect_tpu_chips()
             if chips:
-                res.setdefault("TPU", float(chips))
+                # cfg.chip_resource lets heterogeneous fleets rename the
+                # logical chip resource (e.g. "TPU_V5E") cluster-wide
+                res.setdefault(cfg.chip_resource, float(chips))
             nodelet_proc, nodelet_addr, node_id_hex, store_name = start_nodelet(
                 session_dir, cfg, gcs_addr, resources=res)
             procs.append(nodelet_proc)
@@ -204,10 +206,25 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
     runtime = _rt.get_runtime()
     if isinstance(refs, ObjectRef):
-        return runtime.get([refs], timeout=timeout)[0]
-    if not isinstance(refs, (list, tuple)):
+        single, refs = True, [refs]
+    elif isinstance(refs, (list, tuple)):
+        single, refs = False, list(refs)
+    else:
         raise TypeError(f"ray_tpu.get expects ObjectRef or list, got {type(refs)}")
-    return runtime.get(list(refs), timeout=timeout)
+    t0 = time.monotonic()
+    out = runtime.get(refs, timeout=timeout)
+    elapsed = time.monotonic() - t0
+    warn_s = runtime.cfg.get_timeout_warn_s
+    if warn_s > 0 and elapsed > warn_s:
+        # ref: ray's "waiting for X seconds" driver warning — a slow get
+        # usually means a lost/hung producer, not a slow transfer
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ray_tpu.get of %d ref(s) blocked for %.1fs "
+            "(get_timeout_warn_s=%.1fs); pass timeout= to bound waits",
+            len(refs), elapsed, warn_s)
+    return out[0] if single else out
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,

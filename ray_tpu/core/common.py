@@ -210,7 +210,15 @@ class ResourceSet:
         q: Dict[str, float] = {}
         q["CPU"] = default_cpus if num_cpus is None else float(num_cpus)
         if num_tpus:
-            q["TPU"] = float(num_tpus)
+            # num_tpus is sugar for the logical chip resource; fleets
+            # that rename it (cfg.chip_resource, RAY_TPU_CHIP_RESOURCE)
+            # need task requests and node capacities to agree
+            from ray_tpu.core import runtime as _rt
+            from ray_tpu.core.config import GLOBAL_CONFIG
+
+            r = _rt.current_runtime_or_none()
+            cfg = r.cfg if r is not None else GLOBAL_CONFIG
+            q[cfg.chip_resource] = float(num_tpus)
         if memory:
             q["memory"] = float(memory)
         for k, v in (resources or {}).items():

@@ -1,0 +1,6 @@
+"""Developer tooling that ships with the repo (linters, analyzers).
+
+Nothing under ray_tpu.devtools is imported by the runtime — these are
+build/CI-time tools kept in-tree so the gates they enforce evolve with
+the code they check.
+"""

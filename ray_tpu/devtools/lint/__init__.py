@@ -1,0 +1,17 @@
+"""raylint — distributed-correctness static analysis for ray_tpu.
+
+Run it:            python -m ray_tpu.devtools.lint [paths] [--json]
+Library entry:     run_lint(paths) -> LintReport
+Rule catalog:      python -m ray_tpu.devtools.lint --list-rules
+Suppress a site:   trailing `# raylint: disable=<rule-id> -- why`
+
+The tier-1 gate (tests/test_lint.py) runs the analyzer over ray_tpu/
+and fails on any unsuppressed finding, so the rule suite is a ratchet:
+a pattern added here can never regress back into the tree.
+"""
+
+from ray_tpu.devtools.lint.engine import (LintReport, ParsedFile,  # noqa: F401
+                                          collect_files, run_lint)
+from ray_tpu.devtools.lint.findings import Finding  # noqa: F401
+from ray_tpu.devtools.lint.registry import (Rule, all_rules,  # noqa: F401
+                                            register, rule_ids)

@@ -1,0 +1,75 @@
+"""raylint command line: ``python -m ray_tpu.devtools.lint [paths]``.
+
+Exit code 0 when every finding is suppressed (or there are none),
+1 when unsuppressed findings remain, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ray_tpu.devtools.lint.engine import run_lint
+from ray_tpu.devtools.lint.registry import all_rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="raylint: distributed-correctness static analysis "
+                    "for ray_tpu")
+    parser.add_argument("paths", nargs="*", default=["ray_tpu"],
+                        help="files or directories to lint "
+                             "(default: ray_tpu)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the machine-readable report (stable "
+                             "schema, version 1) instead of text")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="limit to files changed vs git HEAD plus "
+                             "untracked files (fast pre-commit mode); "
+                             "falls back to a full scan without git")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="RULE-ID",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings in text mode")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id:24s} {r.doc}")
+        return 0
+    if args.rule:
+        known = {r.id for r in rules}
+        bad = [r for r in args.rule if r not in known]
+        if bad:
+            print(f"unknown rule(s): {', '.join(bad)}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in set(args.rule)]
+
+    report = run_lint(args.paths, rules=rules,
+                      changed_only=args.changed_only)
+
+    if args.as_json:
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+        # bench.py-style greppable one-liner; stderr keeps stdout pure JSON
+        print(report.summary_line(), file=sys.stderr)
+    else:
+        for f in report.findings:
+            if f.suppressed and not args.show_suppressed:
+                continue
+            print(f.render())
+        print(report.summary_line())
+    return 1 if report.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""raylint engine: file discovery, parsing, rule dispatch.
+
+Degrades gracefully: a file that fails to parse yields a single
+``syntax-error`` finding (it still fails the gate — broken source in
+the tree is a finding, not a crash) and generated/bytecode trees
+(``__pycache__``, ``*_pb2*.py``, ``protobuf/`` output) are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ray_tpu.devtools.lint.findings import Finding
+from ray_tpu.devtools.lint.registry import Rule, all_rules
+from ray_tpu.devtools.lint.suppress import Suppressions
+
+SKIP_DIRS = {"__pycache__", ".git", "build", "node_modules", ".eggs"}
+# generated trees: protobuf output and anything stamped *_pb2
+_GENERATED_MARKERS = ("_pb2.py", "_pb2_grpc.py")
+
+
+@dataclass
+class ParsedFile:
+    path: str
+    source: str
+    tree: ast.Module
+    suppressions: Suppressions
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    files_skipped: int = 0
+    parse_errors: int = 0
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.unsuppressed:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def summary_line(self) -> str:
+        # bench.py-style single greppable line for CI diffing
+        return (f"RAYLINT files={self.files_scanned} "
+                f"findings={len(self.unsuppressed)} "
+                f"suppressed={len(self.suppressed)} "
+                f"parse_errors={self.parse_errors}")
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "summary": {
+                "files_scanned": self.files_scanned,
+                "files_skipped": self.files_skipped,
+                "parse_errors": self.parse_errors,
+                "findings": len(self.unsuppressed),
+                "suppressed": len(self.suppressed),
+                "by_rule": self.by_rule(),
+            },
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+def _is_generated(path: str) -> bool:
+    norm = path.replace(os.sep, "/")
+    if any(norm.endswith(m) for m in _GENERATED_MARKERS):
+        return True
+    # protobuf output dir: skip generated modules, keep the generator
+    parts = norm.split("/")
+    if "protobuf" in parts[:-1]:
+        return parts[-1] not in ("gen.py", "__init__.py")
+    return False
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return [f for f in dict.fromkeys(out) if not _is_generated(f)]
+
+
+def changed_files(repo_root: str = ".") -> Optional[List[str]]:
+    """Paths changed vs HEAD plus untracked files, or None if git is
+    unavailable (caller falls back to a full scan)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"], cwd=repo_root,
+            capture_output=True, text=True, timeout=30, check=True)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30,
+            check=True)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    names = diff.stdout.split() + untracked.stdout.split()
+    return [os.path.join(repo_root, n) if repo_root != "." else n
+            for n in names if n.endswith(".py")]
+
+
+def run_lint(paths: Sequence[str],
+             rules: Optional[Iterable[Rule]] = None,
+             changed_only: bool = False) -> LintReport:
+    report = LintReport()
+    files = collect_files(paths)
+    if changed_only:
+        changed = changed_files()
+        if changed is not None:
+            allowed = {os.path.abspath(c) for c in changed}
+            files = [f for f in files if os.path.abspath(f) in allowed]
+
+    parsed_files: List[ParsedFile] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            report.parse_errors += 1
+            report.findings.append(Finding(
+                rule="syntax-error", path=path,
+                line=e.lineno or 1, col=e.offset or 0,
+                message=f"file does not parse: {e.msg}",
+                hint="raylint skipped this file's rules; fix the syntax"))
+            continue
+        except OSError as e:
+            report.files_skipped += 1
+            report.findings.append(Finding(
+                rule="syntax-error", path=path, line=1, col=0,
+                message=f"file unreadable: {e}"))
+            continue
+        parsed_files.append(
+            ParsedFile(path, source, tree, Suppressions(source)))
+
+    report.files_scanned = len(parsed_files)
+    active = list(rules) if rules is not None else all_rules()
+
+    raw: List[Finding] = []
+    for rule in active:
+        if rule.scope == "project":
+            raw.extend(rule.check_project(parsed_files))
+        else:
+            for pf in parsed_files:
+                raw.extend(rule.check(pf))
+
+    supp_by_path = {pf.path: pf.suppressions for pf in parsed_files}
+    for f in raw:
+        supp = supp_by_path.get(f.path)
+        if supp is not None and supp.is_suppressed(f.rule, f.line):
+            f.suppressed = True
+    report.findings.extend(raw)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return report

@@ -1,0 +1,34 @@
+"""Finding: one diagnostic emitted by a raylint rule."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    rule: str           # rule id, e.g. "leaked-object-ref"
+    path: str           # file the finding is in (as given on the cmdline)
+    line: int           # 1-based
+    col: int            # 0-based, ast convention
+    message: str        # what is wrong at this site
+    hint: str = ""      # how to fix it (one line)
+    suppressed: bool = field(default=False)
+
+    def render(self) -> str:
+        tail = f"  [hint: {self.hint}]" if self.hint else ""
+        sup = "  (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}{tail}{sup}")
+
+    def to_dict(self) -> dict:
+        # Stable --json schema; tests/test_lint.py pins these keys.
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+            "suppressed": self.suppressed,
+        }

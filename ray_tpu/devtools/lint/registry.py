@@ -1,0 +1,57 @@
+"""Rule base class + registry.
+
+A rule is a class with a unique ``id``, a one-line ``doc`` (shown by
+``--list-rules``), and a scope:
+
+- ``scope = "file"``: ``check(parsed)`` is called once per parsed file
+  and yields Findings for that file only.
+- ``scope = "project"``: ``check_project(parsed_files)`` is called once
+  with every parsed file, for rules that need cross-file state (e.g.
+  config-knob-drift's defined-but-never-read direction).
+
+Register with the ``@register`` decorator; ``rules/__init__.py`` imports
+every rule module so importing the package populates the registry.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Type
+
+from ray_tpu.devtools.lint.findings import Finding
+
+_REGISTRY: Dict[str, Type["Rule"]] = {}
+
+
+class Rule:
+    id: str = ""
+    doc: str = ""
+    hint: str = ""
+    scope: str = "file"  # "file" | "project"
+
+    def check(self, parsed) -> Iterable[Finding]:  # file-scope rules
+        return ()
+
+    def check_project(self, parsed_files) -> Iterable[Finding]:  # project
+        return ()
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    # import for side effect: rule modules self-register
+    from ray_tpu.devtools.lint import rules  # noqa: F401
+
+    return [cls() for _, cls in sorted(_REGISTRY.items())]
+
+
+def rule_ids() -> List[str]:
+    from ray_tpu.devtools.lint import rules  # noqa: F401
+
+    return sorted(_REGISTRY)

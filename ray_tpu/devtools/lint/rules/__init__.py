@@ -1,0 +1,6 @@
+"""Rule modules. Importing this package registers every rule."""
+
+from ray_tpu.devtools.lint.rules import (blocking_async,  # noqa: F401
+                                         closure_capture, config_drift,
+                                         divergent_collective, leaked_ref,
+                                         pep479)

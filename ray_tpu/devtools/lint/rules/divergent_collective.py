@@ -1,0 +1,105 @@
+"""divergent-collective: collective op call under a rank conditional.
+
+Collective operations (allreduce/allgather/broadcast/reducescatter/
+barrier) are rendezvous points: every rank in the group must reach the
+same call in the same order, or the ranks that did call it block until
+the per-round timeout fires and the whole slice aborts. ``if rank ==
+0: broadcast(...)`` is the canonical deadlock — broadcast is collective
+even for the source rank.
+
+Flags calls whose callee is a known collective op when the call sits in
+an ``if``/ternary whose test mentions a rank-ish name AND the same op
+is not also called in the opposite branch (``broadcast(x) if rank == 0
+else broadcast(None)`` is convergent: every rank still makes the
+call). Matches bare names (``from ray_tpu.collective import barrier``)
+and dotted calls through a collective-ish receiver
+(``collective.barrier``, ``col.allreduce``, ``self.group.barrier``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from ray_tpu.devtools.lint.astutil import dotted_name
+from ray_tpu.devtools.lint.findings import Finding
+from ray_tpu.devtools.lint.registry import Rule, register
+
+_OPS = {
+    "allreduce", "allgather", "broadcast", "reducescatter", "barrier",
+    "allreduce_async", "allgather_async", "broadcast_async",
+    "reducescatter_async", "barrier_async",
+}
+_RECEIVER_WORDS = ("collective", "col", "group", "comm")
+_RANK_WORDS = ("rank", "is_leader", "is_root", "is_coordinator")
+
+
+def _collective_op(call: ast.Call) -> str:
+    """The op name if this is a collective call, else ''."""
+    name = dotted_name(call.func)
+    parts = name.split(".")
+    if parts[-1] not in _OPS:
+        return ""
+    if len(parts) > 1 and not any(w in p for p in parts[:-1]
+                                  for w in _RECEIVER_WORDS):
+        return ""
+    return parts[-1]
+
+
+def _mentions_rank(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        word = None
+        if isinstance(node, ast.Name):
+            word = node.id
+        elif isinstance(node, ast.Attribute):
+            word = node.attr
+        if word and any(w in word.lower() for w in _RANK_WORDS):
+            return True
+    return False
+
+
+def _branch_calls(nodes) -> List[Tuple[str, ast.Call]]:
+    out = []
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Call):
+                op = _collective_op(sub)
+                if op:
+                    out.append((op, sub))
+    return out
+
+
+@register
+class DivergentCollective(Rule):
+    id = "divergent-collective"
+    doc = ("collective op called in one arm of an `if rank...` branch — "
+           "ranks that skip the call deadlock the group")
+    hint = ("hoist the collective out of the conditional (all ranks call "
+            "it); branch on rank only around the non-collective work")
+
+    def check(self, parsed):
+        seen: Set[int] = set()
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, ast.If) and _mentions_rank(node.test):
+                body, orelse = _branch_calls(node.body), \
+                    _branch_calls(node.orelse)
+            elif isinstance(node, ast.IfExp) and _mentions_rank(node.test):
+                body, orelse = _branch_calls([node.body]), \
+                    _branch_calls([node.orelse])
+            else:
+                continue
+            body_ops = {op for op, _ in body}
+            else_ops = {op for op, _ in orelse}
+            for op, call in body + orelse:
+                if op in body_ops and op in else_ops:
+                    continue  # convergent: both arms make the call
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                yield Finding(
+                    rule=self.id, path=parsed.path,
+                    line=call.lineno, col=call.col_offset,
+                    message=f"collective {dotted_name(call.func)}(...) "
+                            "inside a rank-dependent branch — ranks not "
+                            "taking this branch deadlock the group",
+                    hint=self.hint)

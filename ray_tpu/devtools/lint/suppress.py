@@ -1,0 +1,62 @@
+"""Inline suppression parsing.
+
+Syntax (mirrors pylint's, namespaced so the two coexist):
+
+    x.remote(payload)  # raylint: disable=leaked-object-ref  -- fire&forget push
+
+suppresses the named rule(s) on that line. A comment-only line
+suppresses the line *below* it (for statements too long to share a line
+with their justification):
+
+    # raylint: disable=divergent-collective -- root-only barrier by design
+    collective.barrier()
+
+`disable=all` suppresses every rule on the line. A file-level opt-out
+
+    # raylint: disable-file=large-closure-capture
+
+anywhere in the file suppresses that rule for the whole file (reserved
+for generated or fixture code; real code should suppress per-line with a
+justification).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Set
+
+_RULE_LIST = r"([\w-]+(?:\s*,\s*[\w-]+)*)"
+_LINE_RE = re.compile(r"#\s*raylint:\s*disable=" + _RULE_LIST)
+_FILE_RE = re.compile(r"#\s*raylint:\s*disable-file=" + _RULE_LIST)
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+def _rules_of(match: re.Match) -> Set[str]:
+    return {r.strip() for r in match.group(1).split(",") if r.strip()}
+
+
+class Suppressions:
+    """Per-file suppression table, queried by (rule, line)."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.file_level: Set[str] = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _FILE_RE.search(text)
+            if m:
+                self.file_level |= _rules_of(m)
+                continue
+            m = _LINE_RE.search(text)
+            if not m:
+                continue
+            rules = _rules_of(m)
+            self.by_line.setdefault(i, set()).update(rules)
+            if _COMMENT_ONLY_RE.match(text):
+                # comment-only directive also covers the next line
+                self.by_line.setdefault(i + 1, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_level or "all" in self.file_level:
+            return True
+        rules = self.by_line.get(line, ())
+        return rule in rules or "all" in rules

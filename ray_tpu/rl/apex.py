@@ -193,21 +193,27 @@ class ApexDQNTrainer(Algorithm):
         # drain landed samples into shards (non-blocking fan-in)
         ready, _ = ray_tpu.wait(list(self._inflight),
                                 num_returns=len(self._inflight), timeout=0.2)
+        adds = []
         for ref in ready:
             i = self._inflight.pop(ref)
             b = ray_tpu.get(ref)
             n = len(b["rewards"])
             self.timesteps += n
             self._since_target_sync += n
-            self._shard(i).add_batch.remote(b)
+            adds.append(self._shard(i).add_batch.remote(b))
             # net is unchanged until the update loop below; reuse the
             # host copy instead of a device_get per landed sample
             self._launch(i, net_host)
+        if adds:
+            # a failed add would otherwise vanish with the dropped ref
+            # and silently shrink the replay stream
+            ray_tpu.get(adds)
 
         loss = float("nan")
         updates = 0
         sizes = ray_tpu.get([s.size.remote() for s in self.shards])
         if sum(sizes) >= cfg.learning_starts:
+            prio_refs = []
             for u in range(cfg.updates_per_iter):
                 shard = self.shards[u % len(self.shards)]
                 mb = ray_tpu.get(shard.sample.remote(
@@ -218,9 +224,14 @@ class ApexDQNTrainer(Algorithm):
                 mb = {k: jnp.asarray(v) for k, v in mb.items()}
                 self.net, self.opt_state, loss, td = self._update(
                     self.net, self.target, self.opt_state, mb)
-                shard.update_priorities.remote(indices, np.asarray(td))
+                prio_refs.append(
+                    shard.update_priorities.remote(indices, np.asarray(td)))
                 updates += 1
                 self.num_updates += 1
+            if prio_refs:
+                # surface failed priority writes (they'd skew sampling
+                # toward stale TD errors with no visible symptom)
+                ray_tpu.get(prio_refs)
             if self._since_target_sync >= cfg.target_network_update_freq:
                 self.target = jax.tree_util.tree_map(lambda x: x, self.net)
                 self._since_target_sync = 0
@@ -380,17 +391,23 @@ class ApexDDPGTrainer(Algorithm):
 
         ready, _ = ray_tpu.wait(list(self._inflight),
                                 num_returns=len(self._inflight), timeout=0.2)
+        adds = []
         for ref in ready:
             i = self._inflight.pop(ref)
             b = ray_tpu.get(ref)
             self.timesteps += len(b["rewards"])
-            self.shards[i % len(self.shards)].add_batch.remote(b)
+            adds.append(self.shards[i % len(self.shards)].add_batch.remote(b))
             self._launch(i, actor_host)
+        if adds:
+            # a failed add would otherwise vanish with the dropped ref
+            # and silently shrink the replay stream
+            ray_tpu.get(adds)
 
         loss = float("nan")
         updates = 0
         sizes = ray_tpu.get([s.size.remote() for s in self.shards])
         if sum(sizes) >= cfg.learning_starts:
+            prio_refs = []
             for u in range(cfg.updates_per_iter):
                 shard = self.shards[u % len(self.shards)]
                 mb = ray_tpu.get(shard.sample.remote(
@@ -402,9 +419,14 @@ class ApexDDPGTrainer(Algorithm):
                 (self.nets, self.target, self.actor_os, self.critic_os,
                  loss, td) = self._update(self.nets, self.target,
                                           self.actor_os, self.critic_os, mb)
-                shard.update_priorities.remote(indices, np.asarray(td))
+                prio_refs.append(
+                    shard.update_priorities.remote(indices, np.asarray(td)))
                 updates += 1
                 self.num_updates += 1
+            if prio_refs:
+                # surface failed priority writes (they'd skew sampling
+                # toward stale TD errors with no visible symptom)
+                ray_tpu.get(prio_refs)
             loss = float(loss)
 
         stats = ray_tpu.get([w.episode_stats.remote() for w in self.workers])

@@ -239,4 +239,11 @@ def shutdown():
         return
     for name in ray_tpu.get(controller.list_deployments.remote()):
         ray_tpu.get(controller.delete_deployment.remote(name))
+    try:
+        # stop the control-loop thread before killing the actor: under
+        # lane packing the daemon thread would outlive the actor in the
+        # shared worker process (see ServeController.shutdown)
+        ray_tpu.get(controller.shutdown.remote(), timeout=10)
+    except Exception:
+        pass  # best effort; kill() still tears down the lane
     ray_tpu.kill(controller)

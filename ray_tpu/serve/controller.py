@@ -150,7 +150,7 @@ class ServeController:
         self.deployments: Dict[str, dict] = {}
         self.routes: Dict[str, str] = {}   # route_prefix -> ingress deployment
         self._lock = threading.Lock()
-        self._stop = False
+        self._stop = threading.Event()
         # long-poll channels (ref: long_poll.py LongPollHost): generation
         # per key; waiters block on the condition until the key's gen
         # advances past theirs.
@@ -298,6 +298,19 @@ class ServeController:
     def ping(self) -> str:
         return "pong"
 
+    def shutdown(self) -> bool:
+        """Stop the control loop before the actor is killed. Actors can
+        be lane-packed into shared worker processes, so a daemon thread
+        left spinning outlives its actor and keeps health-probing dead
+        replicas forever."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        # wake any parked long-pollers so their handles return instead
+        # of riding out the full poll timeout against a dead controller
+        with self._poll_cond:
+            self._poll_cond.notify_all()
+        return not self._thread.is_alive()
+
     # ---- reconcile ----------------------------------------------------------
 
     def _make_replica(self, name: str, d: dict):
@@ -435,8 +448,7 @@ class ServeController:
 
     def _control_loop(self):
         """Dead-replica replacement + windowed autoscaling."""
-        while not self._stop:
-            time.sleep(1.0)
+        while not self._stop.wait(1.0):
             for name in list(self.deployments):
                 d = self.deployments.get(name)
                 if d is None:

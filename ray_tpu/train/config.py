@@ -27,7 +27,14 @@ class ScalingConfig:
         r = dict(self.resources_per_worker)
         r.setdefault("CPU", 1.0)
         if self.use_tpu and self.chips_per_worker:
-            r["TPU"] = float(self.chips_per_worker)
+            # resolve the logical chip resource name the same way task
+            # submission does (cfg.chip_resource; "TPU" by default)
+            from ray_tpu.core import runtime as _rt
+            from ray_tpu.core.config import GLOBAL_CONFIG
+
+            rt = _rt.current_runtime_or_none()
+            cfg = rt.cfg if rt is not None else GLOBAL_CONFIG
+            r[cfg.chip_resource] = float(self.chips_per_worker)
         return r
 
 

@@ -562,6 +562,9 @@ class Tuner:
                     decision = scheduler.on_result(tid, r)
                     if decision == STOP and not poll["done"]:
                         try:
+                            # advisory stop; a get() here could block the
+                            # whole tuner loop behind one hung trial
+                            # raylint: disable=leaked-object-ref -- advisory
                             st["actor"].request_stop.remote()
                         except Exception:
                             pass

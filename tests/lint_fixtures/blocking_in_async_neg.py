@@ -1,0 +1,20 @@
+"""Negative: async-safe equivalents and non-blocking lookalikes."""
+import asyncio
+import time
+
+
+async def poll(runtime, refs, executor):
+    await asyncio.sleep(0.5)
+    # run_in_executor moves the blocking read off the loop
+    loop = asyncio.get_running_loop()
+    values = await loop.run_in_executor(None, runtime.get_blocking, refs)
+    # pool.get is an RPC-client lookup, not a blocking read
+    client = runtime.pool.get(runtime.nodelet_addr)
+    # dict .get is not an object-store read
+    meta = {}.get("key")
+    return values, client, meta
+
+
+def sync_path(runtime, refs):
+    time.sleep(0.1)            # fine outside async def
+    return runtime.get(refs)   # fine outside async def

@@ -1,0 +1,16 @@
+"""Positive: blocking calls on the event loop."""
+import time
+
+import ray_tpu
+
+
+async def poll(runtime, refs):
+    time.sleep(0.5)                 # blocks every coroutine on the loop
+    values = ray_tpu.get(refs)      # synchronous object-store read
+    ready, _ = runtime.wait(refs)   # synchronous wait
+    return values, ready
+
+
+class Mailbox:
+    async def take(self, rt, ref):
+        return rt.get([ref])        # blocking read via runtime alias

@@ -1,0 +1,26 @@
+"""Negative: every knob defined is read, every read knob is defined."""
+from dataclasses import dataclass
+
+
+@dataclass
+class Config:
+    object_store_memory: int = 2 ** 31
+    worker_lease_timeout_s: float = 30.0
+
+    def override(self, d):
+        for k, v in d.items():
+            setattr(self, k, v)
+        return self
+
+
+def plan_budget(cfg: Config):
+    budget = cfg.object_store_memory // 2
+    deadline = cfg.worker_lease_timeout_s
+    cfg.override({"worker_lease_timeout_s": 60.0})  # method, not a knob
+    return budget, deadline
+
+
+def untyped_receiver(cfg):
+    # no Config evidence for this receiver: a different cfg object's
+    # attributes are not knob reads and must not be flagged
+    return cfg.rollout_fragment_length

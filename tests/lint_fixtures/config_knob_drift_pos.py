@@ -1,0 +1,17 @@
+"""Positive: an unknown knob read and a dead knob definition."""
+from dataclasses import dataclass
+
+
+@dataclass
+class Config:
+    object_store_memory: int = 2 ** 31
+    worker_lease_timeout_s: float = 30.0
+    orphaned_tuning_knob: float = 0.5       # defined, never read anywhere
+
+
+def plan_budget():
+    cfg = Config()
+    budget = cfg.object_store_memory // 2
+    # typo'd knob: Config defines worker_lease_timeout_s
+    deadline = cfg.worker_lease_timeout
+    return budget, deadline

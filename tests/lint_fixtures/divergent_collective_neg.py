@@ -1,0 +1,21 @@
+"""Negative: convergent collectives and rank-local branching."""
+from ray_tpu.collective import allreduce, barrier, broadcast
+
+
+def sync_params(grads):
+    total = allreduce(grads)            # unconditional: every rank calls
+    barrier()
+    return total
+
+
+def share_seed(rank, seed):
+    # convergent: both arms make the broadcast call, so every rank
+    # reaches the rendezvous (src passes the payload, rest pass None)
+    value = broadcast(seed) if rank == 0 else broadcast(None)
+    return value
+
+
+def log_on_leader(rank, stats, sink):
+    barrier()                           # all ranks sync first
+    if rank == 0:
+        sink.write(stats)               # rank-local work is fine to branch

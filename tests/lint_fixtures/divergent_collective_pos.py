@@ -1,0 +1,22 @@
+"""Positive: collective calls only some ranks reach."""
+from ray_tpu.collective import allreduce, barrier
+
+
+def sync_params(grads, rank):
+    if rank == 0:
+        total = allreduce(grads)        # ranks 1..n never enter -> deadlock
+    else:
+        total = None
+    return total
+
+
+def checkpoint(state, col, world):
+    if col.get_rank() == 0:
+        col.barrier()                   # only rank 0 hits the rendezvous
+        return state
+
+
+def leader_gate(self, data):
+    if self.is_leader:
+        barrier()                       # leader-only barrier hangs the rest
+    return data

@@ -1,0 +1,32 @@
+"""Negative: arrays passed by ref/argument or built inside the task."""
+import numpy as np
+
+import ray_tpu
+
+EMBEDDING_TABLE = np.random.randn(50000, 512)
+VOCAB_SIZE = 50000                          # plain scalar: cheap to close over
+
+
+@ray_tpu.remote
+def embed(table, token_ids):
+    return table[token_ids]                 # passed as argument (or ObjectRef)
+
+
+@ray_tpu.remote
+def build_and_embed(token_ids):
+    table = np.random.randn(50000, 512)     # built inside the task
+    return table[token_ids]
+
+
+@ray_tpu.remote
+def count(token_ids):
+    return len(token_ids) % VOCAB_SIZE      # scalar capture is fine
+
+
+def local_embed(token_ids):
+    return EMBEDDING_TABLE[token_ids]       # not a remote fn
+
+
+def main():
+    table_ref = ray_tpu.put(EMBEDDING_TABLE)
+    return embed.remote(table_ref, [1, 2, 3])

@@ -1,0 +1,19 @@
+"""Positive: remote fns closing over module-level array constants."""
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+
+EMBEDDING_TABLE = np.random.randn(50000, 512)
+ROPE_FREQS = jnp.arange(0, 64, dtype=jnp.float32)
+
+
+@ray_tpu.remote
+def embed(token_ids):
+    return EMBEDDING_TABLE[token_ids]       # ~100MB pickled per task
+
+
+@ray_tpu.remote
+class Encoder:
+    def rotate(self, x):
+        return x * ROPE_FREQS               # device constant, D2H per task

@@ -1,0 +1,18 @@
+"""Negative: refs kept, consumed, or explicitly suppressed."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def work(x):
+    return x + 1
+
+
+def run(actor, batches):
+    refs = [work.remote(b) for b in batches]      # kept in a list
+    ray_tpu.get(refs)
+    ref = actor.ingest.remote(batches[0])         # assigned
+    ray_tpu.wait([ref])
+    # raylint: disable=leaked-object-ref -- fire-and-forget metrics push
+    actor.record_metric.remote("batches", len(batches))
+    actor.flush.remote()  # raylint: disable=leaked-object-ref -- best effort
+    return ref

@@ -1,0 +1,17 @@
+"""Positive: .remote() results discarded as bare statements."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def work(x):
+    return x + 1
+
+
+class Driver:
+    def run(self, actor, batch):
+        work.remote(batch)                    # leaked: plain function task
+        actor.ingest.remote(batch)            # leaked: actor method task
+
+
+async def arun(actor, batch):
+    await actor.ingest.remote(batch)          # leaked even when awaited

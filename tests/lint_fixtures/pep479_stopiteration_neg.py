@@ -1,0 +1,37 @@
+"""Negative: guarded next(), defaults, plain return, non-generators."""
+
+
+def merge(iters):
+    while iters:
+        exhausted = []
+        for it in iters:
+            try:
+                yield next(it)          # guarded: exhaustion handled
+            except StopIteration:
+                exhausted.append(it)
+        for it in exhausted:
+            iters.remove(it)
+
+
+def first_or_none(iters):
+    for it in iters:
+        yield next(it, None)            # two-arg next never raises
+
+
+def countdown(n):
+    while True:
+        if n == 0:
+            return                      # the PEP 479 way to end
+        yield n
+        n -= 1
+
+
+class Cursor:
+    def __next__(self):
+        # fine: __next__ is not a generator body; raising StopIteration
+        # is its contract
+        raise StopIteration
+
+
+def helper(it):
+    return next(it)                     # fine: not a generator

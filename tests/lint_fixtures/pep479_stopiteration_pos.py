@@ -1,0 +1,15 @@
+"""Positive: StopIteration escaping generator bodies (PEP 479)."""
+
+
+def merge(iters):
+    while iters:
+        for it in iters:
+            yield next(it)          # unguarded: exhaustion -> RuntimeError
+
+
+def countdown(n):
+    while True:
+        if n == 0:
+            raise StopIteration     # becomes RuntimeError; use return
+        yield n
+        n -= 1

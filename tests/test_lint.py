@@ -1,0 +1,174 @@
+"""raylint: tier-1 gate + per-rule fixture suite.
+
+The gate (`test_ray_tpu_tree_is_clean`) runs the analyzer over the whole
+ray_tpu/ package and fails on any unsuppressed finding, which makes the
+rule suite a one-way ratchet: a hazard pattern added to the catalog can
+never regress back into the tree.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools.lint import all_rules, rule_ids, run_lint
+from ray_tpu.devtools.lint.engine import collect_files
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+
+def _fixture(rule_id: str, kind: str) -> str:
+    return os.path.join(FIXTURES, f"{rule_id.replace('-', '_')}_{kind}.py")
+
+
+# ---- the tier-1 gate -------------------------------------------------------
+
+def test_ray_tpu_tree_is_clean():
+    report = run_lint([PKG])
+    assert report.files_scanned > 100, "lint saw too few files — broken walk?"
+    unsuppressed = report.unsuppressed
+    msg = "\n".join(f.render() for f in unsuppressed)
+    assert not unsuppressed, f"raylint findings in ray_tpu/:\n{msg}"
+    assert report.parse_errors == 0
+
+
+# ---- per-rule fixtures -----------------------------------------------------
+
+def test_every_rule_has_fixtures():
+    """New rules can't ship untested: both fixture files must exist."""
+    missing = [f"{rid}: {kind}" for rid in rule_ids()
+               for kind in ("pos", "neg")
+               if not os.path.exists(_fixture(rid, kind))]
+    assert not missing, f"rules without fixtures: {missing}"
+
+
+@pytest.mark.parametrize("rule_id", rule_ids())
+def test_rules(rule_id):
+    rule = next(r for r in all_rules() if r.id == rule_id)
+    pos = run_lint([_fixture(rule_id, "pos")], rules=[rule])
+    hits = [f for f in pos.unsuppressed if f.rule == rule_id]
+    assert hits, f"{rule_id}: positive fixture triggered nothing"
+    for f in hits:
+        assert f.line > 0 and f.message and f.path.endswith("_pos.py")
+
+    neg = run_lint([_fixture(rule_id, "neg")], rules=[rule])
+    bad = [f.render() for f in neg.unsuppressed if f.rule == rule_id]
+    assert not bad, f"{rule_id}: negative fixture flagged:\n" + "\n".join(bad)
+
+
+# ---- suppressions ----------------------------------------------------------
+
+def test_suppressed_findings_counted_not_fatal(tmp_path):
+    src = textwrap.dedent("""\
+        def kick(actor, x):
+            actor.go.remote(x)  # raylint: disable=leaked-object-ref -- why
+    """)
+    p = tmp_path / "supp.py"
+    p.write_text(src)
+    report = run_lint([str(p)])
+    assert not report.unsuppressed
+    assert [f.rule for f in report.suppressed] == ["leaked-object-ref"]
+
+
+def test_suppression_comment_above(tmp_path):
+    src = textwrap.dedent("""\
+        def kick(actor, x):
+            # raylint: disable=leaked-object-ref -- fire and forget
+            actor.go.remote(x)
+    """)
+    p = tmp_path / "supp2.py"
+    p.write_text(src)
+    report = run_lint([str(p)])
+    assert not report.unsuppressed and len(report.suppressed) == 1
+
+
+def test_wrong_rule_suppression_does_not_mask(tmp_path):
+    src = "def kick(a, x):\n    a.go.remote(x)  # raylint: disable=pep479-stopiteration\n"
+    p = tmp_path / "supp3.py"
+    p.write_text(src)
+    report = run_lint([str(p)])
+    assert [f.rule for f in report.unsuppressed] == ["leaked-object-ref"]
+
+
+# ---- resilience ------------------------------------------------------------
+
+def test_syntax_error_reported_not_crash(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("def ok():\n    return 1\n")
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    report = run_lint([str(tmp_path)])
+    assert report.parse_errors == 1
+    assert report.files_scanned == 1  # good.py still analyzed
+    assert any(f.rule == "syntax-error" and f.path.endswith("bad.py")
+               for f in report.findings)
+
+
+def test_skips_pycache_and_generated(tmp_path):
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.py").write_text("x.go.remote(1)\n")
+    (tmp_path / "schema_pb2.py").write_text("x.go.remote(1)\n")
+    (tmp_path / "protobuf").mkdir()
+    (tmp_path / "protobuf" / "msgs.py").write_text("x.go.remote(1)\n")
+    (tmp_path / "real.py").write_text("y = 1\n")
+    files = collect_files([str(tmp_path)])
+    assert [os.path.basename(f) for f in files] == ["real.py"]
+
+
+# ---- CLI: --json schema + summary line ------------------------------------
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.lint", *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+
+
+def test_cli_json_schema():
+    proc = _run_cli("--json", _fixture("leaked-object-ref", "pos"))
+    assert proc.returncode == 1, proc.stderr  # unsuppressed findings
+    doc = json.loads(proc.stdout)  # stdout is pure JSON...
+    assert "RAYLINT" in proc.stderr  # ...summary one-liner on stderr
+    assert doc["version"] == 1
+    summary = doc["summary"]
+    for key in ("files_scanned", "files_skipped", "parse_errors",
+                "findings", "suppressed", "by_rule"):
+        assert key in summary
+    assert summary["findings"] >= 1
+    assert summary["by_rule"].get("leaked-object-ref", 0) >= 1
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "hint", "suppressed"}
+        assert isinstance(f["line"], int) and isinstance(f["suppressed"], bool)
+
+
+def test_cli_summary_line_and_exit_codes():
+    clean = _run_cli(_fixture("leaked-object-ref", "neg"))
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    last = clean.stdout.strip().splitlines()[-1]
+    assert last.startswith("RAYLINT files=1 findings=0"), last
+
+    dirty = _run_cli(_fixture("leaked-object-ref", "pos"))
+    assert dirty.returncode == 1
+    assert "RAYLINT" in dirty.stdout.strip().splitlines()[-1]
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in rule_ids():
+        assert rid in proc.stdout
+
+
+def test_cli_changed_only_runs():
+    # smoke: flag must not crash whether or not git sees changes
+    proc = _run_cli("--changed-only", os.path.join(REPO, "tests",
+                                                   "lint_fixtures"))
+    assert proc.returncode in (0, 1), proc.stderr
+    assert "RAYLINT" in proc.stdout
