@@ -352,12 +352,22 @@ def internal_stats() -> Dict[str, dict]:
     return out
 
 
-def timeline(limit: int = 1000) -> List[dict]:
-    """Recent task state transitions (and tracing spans) from the GCS
-    task-event store (ref: `ray timeline` scripts.py:1835)."""
+def timeline(limit: int = 1000, chrome: bool = False) -> List[dict]:
+    """Recent task state transitions and tracing spans from the GCS
+    task-event store (ref: `ray timeline` scripts.py:1835). Flushes the
+    local TelemetryAgent first, so spans recorded just before the call
+    are visible (read-your-writes). `chrome=True` returns the merged
+    Chrome trace with per-worker lanes instead of raw events
+    (observability/timeline.py) — json.dump it and load in
+    chrome://tracing."""
     rt = _rt.get_runtime()
     rt.flush_task_events(wait=True)
-    return rt.gcs_call("list_task_events", limit=limit)
+    events = rt.gcs_call("list_task_events", limit=limit)
+    if chrome:
+        from ray_tpu.observability import chrome_trace
+
+        return chrome_trace(events)
+    return events
 
 
 __all__ = [

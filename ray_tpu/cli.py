@@ -73,7 +73,8 @@ def cmd_list(args):
     from ray_tpu.util import state
 
     fn = {"nodes": state.list_nodes, "actors": state.list_actors,
-          "tasks": state.list_tasks, "jobs": state.list_jobs}[args.what]
+          "tasks": state.list_tasks, "jobs": state.list_jobs,
+          "edges": state.edge_stats}[args.what]
     print(json.dumps(fn(), indent=2, default=str))
 
 
@@ -149,39 +150,19 @@ def cmd_gateway(args):
 
 
 def cmd_timeline(args):
-    """Chrome-trace export of task events (ref: ray timeline)."""
+    """Chrome-trace export of the unified timeline — task states, user
+    spans, collective rounds, data-op spans — with per-worker lanes
+    (ref: ray timeline; observability/timeline.py)."""
     ray_tpu = _connect(args.address)
+    from ray_tpu.observability import chrome_trace
+
     events = ray_tpu.timeline(limit=args.limit)
-    trace = []
-    starts = {}
-    for ev in reversed(events):
-        if ev.get("kind") == "span":
-            # tracing spans share the task-event store (util/tracing.py)
-            trace.append({
-                "name": ev["name"], "cat": "span", "ph": "X",
-                "ts": ev["ts"] * 1e6, "dur": ev.get("dur", 0) * 1e6,
-                "pid": 1, "tid": hash(ev["trace_id"]) % 64,
-                "args": {**ev.get("attrs", {}),
-                         "trace_id": ev["trace_id"],
-                         "span_id": ev["span_id"],
-                         "parent_id": ev.get("parent_id")},
-            })
-            continue
-        key = ev["task_id"]
-        if ev["state"] == "RUNNING":
-            starts[key] = ev["ts"]
-        elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
-            trace.append({
-                "name": ev["name"], "cat": "task", "ph": "X",
-                "ts": starts[key] * 1e6,
-                "dur": (ev["ts"] - starts.pop(key)) * 1e6,
-                "pid": 0, "tid": hash(key) % 64,
-                "args": {"state": ev["state"]},
-            })
+    trace = chrome_trace(events)
     out = args.output or "timeline.json"
     with open(out, "w") as f:
         json.dump(trace, f)
-    print(f"wrote {len(trace)} spans to {out}")
+    n = sum(1 for e in trace if e.get("ph") != "M")
+    print(f"wrote {n} slices to {out}")
 
 
 def cmd_memory(args):
@@ -269,7 +250,8 @@ def main():
         s.set_defaults(fn=fn)
 
     s = sub.add_parser("list")
-    s.add_argument("what", choices=["nodes", "actors", "tasks", "jobs"])
+    s.add_argument("what", choices=["nodes", "actors", "tasks", "jobs",
+                                    "edges"])
     s.add_argument("--address", required=True)
     s.set_defaults(fn=cmd_list)
 

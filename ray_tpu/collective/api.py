@@ -33,6 +33,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.collective import pytree as _pt
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.collective.errors import CollectiveError, CollectiveTimeoutError
 from ray_tpu.collective.group import GroupContext
 from ray_tpu.collective.registry import (available_backends,
@@ -131,8 +132,16 @@ class GroupClient:
 
     # -- ops -------------------------------------------------------------
 
+    def _span(self, op: str):
+        """Collective rounds are timeline spans (no-op when tracing is
+        off) — they land in the recording worker's lane next to its
+        tasks."""
+        return _tracing.span(f"collective::{op}",
+                             {"group": self.name, "rank": self.rank,
+                              "world": self.world})
+
     def allreduce(self, tensor):
-        with self._op_lock:
+        with self._op_lock, self._span("allreduce"):
             if _pt.is_leaf(tensor):
                 arr = np.asarray(tensor)
                 return self._backend("allreduce", arr.nbytes).allreduce(arr)
@@ -144,14 +153,14 @@ class GroupClient:
                                       _pt.unpack_leaves(reduced, layout))
 
     def allgather(self, value) -> List[Any]:
-        with self._op_lock:
+        with self._op_lock, self._span("allgather"):
             return self._backend("allgather").allgather(value)
 
     def broadcast(self, value, src_rank: int = 0):
         if not (0 <= src_rank < self.world):
             raise ValueError(f"broadcast: src_rank {src_rank} outside "
                              f"world of {self.world}")
-        with self._op_lock:
+        with self._op_lock, self._span("broadcast"):
             data = value if self.rank == src_rank else None
             return self._backend("broadcast").broadcast(data, src_rank)
 
@@ -167,11 +176,11 @@ class GroupClient:
                 f"reducescatter: leading dim {arr.shape[0]} is not "
                 f"divisible by world_size {self.world}; pad the payload "
                 "or pick a scatterable batch dimension")
-        with self._op_lock:
+        with self._op_lock, self._span("reducescatter"):
             return self._backend("reducescatter", arr.nbytes).reducescatter(arr)
 
     def barrier(self) -> None:
-        with self._op_lock:
+        with self._op_lock, self._span("barrier"):
             self._backend("barrier").barrier()
 
     def destroy(self):

@@ -309,6 +309,7 @@ class GroupContext:
 
     def recv(self, src_rank: int, key: str, *, op: str = ""):
         """Blocking take from OWN mailbox of the value `src_rank` pushed."""
+        t0 = time.perf_counter()
         out = self._checked_get(
             self.mailbox.take.remote(key, self.timeout_s),
             op=op, budget_s=self.timeout_s)
@@ -321,8 +322,16 @@ class GroupContext:
                 f"(key {key!r}); unresponsive ranks: {detail}",
                 group_name=self.name, op=op,
                 suspect_ranks=suspects or [src_rank])
-        self.stats.bytes_recv += payload_nbytes(out)
+        n = payload_nbytes(out)
+        self.stats.bytes_recv += n
         self.stats.recvs += 1
+        # Per-edge observation for the EWMA model: round time (includes
+        # sender skew), which is exactly the cost the collective
+        # auto-selector pays per hop on this edge.
+        from ray_tpu.observability.edges import record_transfer
+        record_transfer(self.topology.node_of(src_rank),
+                        self.topology.node_of(self.rank), n,
+                        time.perf_counter() - t0, kind="collective")
         return out
 
     def _checked_get(self, ref, *, op: str, budget_s: float):

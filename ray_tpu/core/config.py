@@ -119,7 +119,11 @@ class Config:
     chip_resource: str = "TPU"
     # --- observability ------------------------------------------------------
     task_event_buffer_size: int = 10000          # ref: task_event_buffer.h:199
-    metrics_report_interval_s: float = 5.0
+    metrics_report_interval_s: float = 5.0       # nodelet node-stats agent
+    # Per-process TelemetryAgent batching window: metric deltas, task
+    # events, spans, and edge observations accumulate locally and ship in
+    # ONE GCS report per interval (ref: metrics_agent.py batched push).
+    telemetry_report_interval_s: float = 1.0
     log_to_driver: bool = True
 
     def override(self, d: Dict[str, Any]) -> "Config":

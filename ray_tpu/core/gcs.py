@@ -95,6 +95,10 @@ class GcsServer:
         self.pending_leases: Dict[NodeID, int] = {}
         self.unmet_demand: List[dict] = []  # infeasible resource asks
         self.task_events: deque = deque(maxlen=cfg.task_event_buffer_size)
+        # per-edge EWMA latency/bandwidth fed by batched telemetry
+        # reports (in-memory: telemetry, re-learned after failover)
+        from ray_tpu.observability.edges import EdgeModel
+        self.edge_model = EdgeModel()
         self.pool = ClientPool()
         self.server = RpcServer(self)
         # pluggable node-picking policies (ref: scheduling/policy/)
@@ -644,6 +648,45 @@ class GcsServer:
         # ref: gcs_task_manager.h bounded task-event store for observability.
         self.task_events.extend(events)
         return {"ok": True}
+
+    async def rpc_telemetry_report(self, report: dict) -> dict:
+        """One batched report from a process's TelemetryAgent (ref:
+        metrics_agent.py push): task events + spans extend the bounded
+        event store, metric deltas merge into KV ns="metrics" (WAL'd like
+        kv_put so scrapers survive failover), edge observations feed the
+        EWMA edge model."""
+        import json
+
+        from ray_tpu.util.metrics import merge_payload
+
+        events = report.get("events") or []
+        if events:
+            self.task_events.extend(events)
+        for ob in report.get("edges") or []:
+            self.edge_model.observe(ob.get("src"), ob.get("dst"),
+                                    ob.get("nbytes", 0.0),
+                                    ob.get("seconds", 0.0),
+                                    ob.get("kind", "transfer"))
+        dirty = False
+        for delta in report.get("metrics") or []:
+            name = delta.get("name")
+            if not name:
+                continue
+            k = ("metrics", name.encode())
+            try:
+                base = json.loads(self.kv[k]) if k in self.kv else None
+            except Exception:
+                base = None
+            value = json.dumps(merge_payload(base, delta)).encode()
+            self.kv[k] = value
+            self._wal("kv", k, value)
+            dirty = True
+        if dirty:
+            self._mark_dirty()
+        return {"ok": True}
+
+    async def rpc_edge_stats(self) -> Dict[str, dict]:
+        return self.edge_model.stats()
 
     async def rpc_list_task_events(self, limit: int = 1000,
                                    job_id: Optional[JobID] = None) -> List[dict]:

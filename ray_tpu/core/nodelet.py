@@ -1096,10 +1096,24 @@ class Nodelet:
             return "ok"
         return "fallback"
 
+    def _object_nbytes(self, oid: ObjectID) -> int:
+        """Size of a sealed local object (edge-telemetry stamping)."""
+        view = self.store.get_view(oid)
+        if view is None:
+            return 0
+        try:
+            return view.nbytes
+        finally:
+            del view
+            self.store.release(oid)
+
     async def rpc_pull_object(self, oid: ObjectID, source: Address) -> dict:
         """Pull a remote object into the local store: native zero-staging
         plane (xfer.cc) when the source runs one, chunked RPC otherwise
-        (ref: PullManager pull_manager.h:52 + ObjectManager::Push)."""
+        (ref: PullManager pull_manager.h:52 + ObjectManager::Push).
+        `nbytes` is present ONLY when bytes actually crossed the wire —
+        already-local / restored hits omit it so pullers don't record
+        phantom transfer edges."""
         if self.store.contains(oid):
             return {"ok": True}
         if await self._restore_local(oid):
@@ -1108,7 +1122,7 @@ class Nodelet:
             return {"ok": False, "error": "object not at source"}
         native = await self._pull_native(oid, source)
         if native == "ok":
-            return {"ok": True}
+            return {"ok": True, "nbytes": self._object_nbytes(oid)}
         if native == "busy":
             # do NOT fall through to chunk RPC: that would route the
             # same bytes through the same saturated source, just slower.
@@ -1147,7 +1161,7 @@ class Nodelet:
             return {"ok": False, "error": str(e)}
         del view
         self.store.seal(oid)
-        return {"ok": True}
+        return {"ok": True, "nbytes": total}
 
     async def rpc_delete_objects(self, oids: List[ObjectID]) -> dict:
         for oid in oids:

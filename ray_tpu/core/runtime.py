@@ -426,9 +426,13 @@ class Runtime:
         self._pg_addr_cache: Dict[Tuple, Address] = {}
         self.default_runtime_env: Optional[dict] = None  # job-level env
         self._renv_cache: Dict[str, dict] = {}
-        self._task_events: List[dict] = []
-        # appended from executor threads (spans), swapped on the loop
-        self._task_events_lock = threading.Lock()
+        # Per-process telemetry agent: task events, spans, metric deltas
+        # and edge observations batch into ONE GCS report per
+        # telemetry_report_interval_s (ref: metrics_agent.py). Imported
+        # lazily — observability.agent pulls in util.metrics which
+        # imports this module.
+        from ray_tpu.observability.agent import TelemetryAgent
+        self.telemetry = TelemetryAgent(self)
         self._gcs_subs: Set[str] = set()  # channels to restore on failover
         self._recon_lock = threading.Lock()  # serializes reconstructions
         self._gcs_sub_gen: Optional[int] = None  # conn generation at last sub
@@ -483,6 +487,12 @@ class Runtime:
         return self.address
 
     def shutdown(self):
+        try:
+            # final batched report BEFORE tearing the loop down — the
+            # flush-on-shutdown half of the agent contract
+            self.telemetry.stop(flush=True)
+        except Exception:
+            pass
         self._shutdown = True
         try:
             self._run(self.server.stop(), timeout=2)
@@ -1074,12 +1084,14 @@ class Runtime:
         remote.sort(key=lambda a: self._busy_sources.get(tuple(a), 0.0) > now)
         busy_seen = False
         for loc in local + remote:
+            t0 = time.perf_counter()
             try:
                 r = self._run(self.pool.get(self.nodelet_addr).call(
                     "pull_object", oid=oid, source=tuple(loc), timeout=120.0))
             except (ConnectionLost, RemoteError, OSError) as e:
                 logger.warning("pull of %s failed: %s", oid.hex()[:12], e)
                 continue
+            pull_s = time.perf_counter() - t0
             if r.get("ok"):
                 v = self._read_local(oid)
                 if v is not _MISSING:
@@ -1088,6 +1100,10 @@ class Runtime:
                         self._pull_sources[oid] = tuple(loc)
                         while len(self._pull_sources) > 1024:
                             self._pull_sources.popitem(last=False)
+                        if r.get("nbytes"):
+                            # an actual cross-node transfer happened (the
+                            # nodelet omits nbytes on already-local hits)
+                            self._record_pull_edge(loc, r["nbytes"], pull_s)
                     return v
             elif r.get("busy"):
                 busy_seen = True
@@ -2567,50 +2583,43 @@ class Runtime:
 
     def _record_event(self, spec: TaskSpec, state: str,
                       worker: Optional[str] = None):
-        """ref: task_event_buffer.h:199 — bounded buffer, flushed to GCS."""
-        with self._task_events_lock:
-            self._task_events.append({
-                "task_id": spec.task_id.hex(), "name": spec.name,
-                "state": state, "job_id": self.job_id, "ts": time.time(),
-                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-                # the EXECUTING worker (None on owner-side PENDING events)
-                # — the dashboard's per-worker timeline lanes
-                "worker": worker})
-            full = len(self._task_events) >= 100
-        if full:
-            self.flush_task_events()
+        """ref: task_event_buffer.h:199 — buffered in the TelemetryAgent,
+        shipped in batched reports (bounded, drops counted)."""
+        self.telemetry.record_event({
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "state": state, "job_id": self.job_id, "ts": time.time(),
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            # the EXECUTING worker (None on owner-side PENDING events)
+            # — the dashboard's per-worker timeline lanes
+            "worker": worker})
 
     def record_span(self, span: dict):
         """Tracing spans ride the task-event channel to the GCS — one
         store serves task states and spans (ref: profile events share the
-        TaskEventBuffer, task_event_buffer.h)."""
-        with self._task_events_lock:
-            self._task_events.append(span)
-            full = len(self._task_events) >= 100
-        if full:
-            self.flush_task_events()
+        TaskEventBuffer, task_event_buffer.h). Stamped with the recording
+        worker so the timeline lanes spans next to the tasks that
+        emitted them."""
+        span.setdefault("worker", self.worker_id.hex()[:12]
+                        if self.mode == "worker" else None)
+        self.telemetry.record_event(span)
+
+    def _record_pull_edge(self, src_addr, nbytes, seconds):
+        """Remote object-pull observation -> per-edge EWMA model."""
+        try:
+            src = self.telemetry.node_of_addr(tuple(src_addr))
+            if src and self.node_id:
+                self.telemetry.record_edge(src, self.node_id, nbytes,
+                                           seconds, kind="object_pull")
+        except Exception:
+            pass
 
     def flush_task_events(self, wait: bool = False):
-        """Ship buffered events; `wait=True` blocks until the GCS acked
-        (readers like `ray_tpu.timeline()` need read-your-writes)."""
-        with self._task_events_lock:
-            evs, self._task_events = self._task_events, []
-        if not evs:
-            return
-        if wait:
-            try:
-                self.gcs_call("add_task_events", events=evs)
-            except Exception:
-                pass
-            return
-
-        async def _send():
-            try:
-                await self.pool.get(self.gcs_addr).call("add_task_events",
-                                                        events=evs, timeout=5.0)
-            except Exception:
-                pass
-        self._spawn(_send())
+        """Ship buffered telemetry; `wait=True` blocks until the GCS
+        acked (readers like `ray_tpu.timeline()` need read-your-writes)
+        and must come from an executor/user thread. `wait=False` is safe
+        from the event-loop thread — buffered items ship within one
+        report interval."""
+        self.telemetry.flush(wait=wait)
 
     # ------------------------------------------------------------------ misc
 

@@ -204,6 +204,11 @@ class DashboardHead:
     async def _h_actors(self, request):
         return self._json(await self._gcs("list_actors"))
 
+    async def _h_edge_stats(self, request):
+        """Measured per-edge transfer model (EWMA latency/bandwidth per
+        src->dst node pair), fed by batched telemetry reports."""
+        return self._json(await self._gcs("edge_stats"))
+
     async def _h_tasks(self, request):
         limit = int(request.query.get("limit", 1000))
         return self._json(await self._gcs("list_task_events", limit=limit))
@@ -524,6 +529,7 @@ class DashboardHead:
         app.router.add_post("/api/jobs/{job_id}/stop", self._h_job_stop)
         app.router.add_get("/api/v0/summary", self._h_summary)
         app.router.add_get("/api/v0/node_stats", self._h_node_stats)
+        app.router.add_get("/api/v0/edge_stats", self._h_edge_stats)
         app.router.add_get("/metrics", self._h_metrics)
         app.router.add_get("/api/v0/logs", self._h_logs)
         self._runner = web.AppRunner(app)

@@ -52,6 +52,7 @@ class StreamingExecutor:
     def _start(self) -> None:
         if not self._started:
             self._started = True
+            self._t_start = time.time()
             for op in self._ops:
                 op.start()
 
@@ -133,6 +134,18 @@ class StreamingExecutor:
             "rounds": len(self.trace),
             "trace": self.trace,
         }
+        # op-lifetime spans onto the unified timeline (no-op unless
+        # tracing is on): one `data::<op>` slice per operator covering
+        # the run, with its metrics as span attributes
+        from ray_tpu.util import tracing
+        t0 = getattr(self, "_t_start", None)
+        if t0 is not None and (tracing.is_enabled()
+                               or tracing.current_context() is not None):
+            dur = time.time() - t0
+            for op in self._ops:
+                tracing.emit_span(f"data::{op.name}", t0, dur,
+                                  {"depth": op.depth,
+                                   **op.metrics.as_dict()})
 
     # --- consumption ---------------------------------------------------------
 

@@ -16,12 +16,21 @@ with the hot math still inside each learner's jit.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu
+
+# XLA's intra-process collective rendezvous deadlocks when two actor lanes
+# in ONE worker process concurrently run jitted programs that each carry a
+# cross-device reduction: the participants split across two run-ids and
+# every device thread waits for a full set that never assembles. Lane-packed
+# learners (num_cpus_per_learner < 1) hit exactly that, so all device
+# execution below is serialized per process.
+_DEVICE_LOCK = threading.Lock()
 
 
 @dataclass
@@ -86,22 +95,25 @@ class Learner:
 
     def update(self, batch: Dict[str, np.ndarray]) -> float:
         """One optimizer step; batch rows sharded over local devices."""
-        self.params, self.opt_state, loss = self._update(
-            self.params, self.opt_state, self._place(batch))
-        return float(loss)
+        with _DEVICE_LOCK:
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, self._place(batch))
+            return float(loss)
 
     def compute_gradients(self, batch):
-        loss, grads = self._grads(self.params, self._place(batch))
         import jax
 
-        return float(loss), jax.device_get(grads)
+        with _DEVICE_LOCK:
+            loss, grads = self._grads(self.params, self._place(batch))
+            return float(loss), jax.device_get(grads)
 
     def apply_gradients(self, grads):
         import optax
 
-        upd, self.opt_state = self.opt.update(grads, self.opt_state,
-                                              self.params)
-        self.params = optax.apply_updates(self.params, upd)
+        with _DEVICE_LOCK:
+            upd, self.opt_state = self.opt.update(grads, self.opt_state,
+                                                  self.params)
+            self.params = optax.apply_updates(self.params, upd)
 
     def get_weights(self):
         import jax

@@ -274,6 +274,42 @@ class LLMEngine:
 
         self.metrics = {"requests": 0, "tokens_generated": 0,
                         "ttft_sum": 0.0, "ttft_count": 0}
+        # Cluster-visible instruments (util.metrics -> batched telemetry
+        # reports), replica-tagged so the future serve router can read
+        # per-replica admission cost and TTFT percentiles from the GCS.
+        # The plain dict above stays the local stats() view.
+        from ray_tpu.util import metrics as _um
+        try:
+            import ray_tpu
+            replica = (ray_tpu.get_runtime_context().get_actor_id()
+                       or "driver")
+        except Exception:
+            replica = "local"
+        tag = {"replica": str(replica)[:16]}
+        self._m_ttft = _um.Histogram(
+            "ray_tpu_serve_ttft_s", "time to first token per request",
+            boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30],
+            tag_keys=("replica",)).set_default_tags(tag)
+        self._m_admit = _um.Counter(
+            "ray_tpu_serve_admit_s", "seconds spent in request admission",
+            tag_keys=("replica",)).set_default_tags(tag)
+        self._m_decode_block = _um.Histogram(
+            "ray_tpu_serve_decode_block_s",
+            "fused decode-block wall seconds",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5],
+            tag_keys=("replica",)).set_default_tags(tag)
+        self._m_tokens = _um.Counter(
+            "ray_tpu_serve_tokens_generated", "generated tokens",
+            tag_keys=("replica",)).set_default_tags(tag)
+
+    def _record_first_token(self, r, now: float) -> None:
+        """Client-visible TTFT, once per request (re-admission after a
+        recompute-preemption must not reset it or double-count)."""
+        r.first_token_time = now
+        ttft = now - r.submit_time
+        self.metrics["ttft_sum"] += ttft
+        self.metrics["ttft_count"] += 1
+        self._m_ttft.observe(ttft)
 
     # ---- submission --------------------------------------------------------
 
@@ -439,13 +475,10 @@ class LLMEngine:
         now = time.time()
         for i, r in pairs:
             r.generated.append(int(first[i]))
-            # re-admission after a recompute-preemption must not reset
-            # the client-visible TTFT or double-count the metric
             if r.first_token_time is None:
-                r.first_token_time = now
-                self.metrics["ttft_sum"] += now - r.submit_time
-                self.metrics["ttft_count"] += 1
+                self._record_first_token(r, now)
             self.metrics["tokens_generated"] += 1
+            self._m_tokens.inc()
             if (self.kv_layout == "paged" and self.prefix_caching
                     and r._filled < self.max_seq):
                 from ray_tpu.serve.paged_kv import page_chain_hashes
@@ -790,10 +823,9 @@ class LLMEngine:
             tok = int(toks[r.slot])
             r.generated.append(tok)
             if r.first_token_time is None:
-                r.first_token_time = now
-                self.metrics["ttft_sum"] += now - r.submit_time
-                self.metrics["ttft_count"] += 1
+                self._record_first_token(r, now)
             self.metrics["tokens_generated"] += 1
+            self._m_tokens.inc()
             self._maybe_finish(r)
             r.progress.set()
         with self.lock:
@@ -810,8 +842,9 @@ class LLMEngine:
 
         t_adm = time.time()
         self._admit()
-        self.metrics["admit_s"] = \
-            self.metrics.get("admit_s", 0.0) + (time.time() - t_adm)
+        adm = time.time() - t_adm
+        self.metrics["admit_s"] = self.metrics.get("admit_s", 0.0) + adm
+        self._m_admit.inc(adm)
         with self.lock:
             active_reqs = [r for r in self.slots if self._decode_ready(r)]
             active_mask = np.array(
@@ -880,16 +913,16 @@ class LLMEngine:
             self.metrics.get("decode_blocks", 0) + 1
         self.metrics["decode_block_tokens"] = \
             self.metrics.get("decode_block_tokens", 0) + n_eff
+        self._m_decode_block.observe(now - t_blk)
         for r in list(active_reqs):
             for j in range(n_eff):
                 if r.slot < 0:
                     break  # finished mid-block; surplus tokens dropped
                 r.generated.append(int(toks[j, r.slot]))
                 if r.first_token_time is None:   # defensive: admission
-                    r.first_token_time = now     # normally records TTFT
-                    self.metrics["ttft_sum"] += now - r.submit_time
-                    self.metrics["ttft_count"] += 1
+                    self._record_first_token(r, now)  # normally did this
                 self.metrics["tokens_generated"] += 1
+                self._m_tokens.inc()
                 self._maybe_finish(r)
             r.progress.set()
         with self.lock:
@@ -1004,6 +1037,10 @@ class LLMServer:
         m = dict(self.engine.metrics)
         if m["ttft_count"]:
             m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
+            p50 = self.engine._m_ttft.quantile(0.5)
+            if p50 is not None:
+                m["ttft_p50_s"] = p50
+                m["ttft_p99_s"] = self.engine._m_ttft.quantile(0.99)
         if getattr(self.engine, "pool", None) is not None:
             m["prefix_cache"] = self.engine.pool.cache_stats()
         return m

@@ -45,6 +45,15 @@ def list_jobs() -> List[dict]:
     return out
 
 
+def edge_stats() -> Dict[str, dict]:
+    """Measured per-edge transfer model, keyed "src_node->dst_node":
+    EWMA latency/bandwidth plus totals, learned from object-store pulls
+    and collective transport rounds (ray_tpu.observability.edges)."""
+    from ray_tpu.observability.edges import edge_stats as _edge_stats
+
+    return _edge_stats()
+
+
 def list_placement_groups() -> List[dict]:
     # round-1: PGs are queried per-id; a GCS listing lands with the
     # observability milestone

@@ -99,6 +99,29 @@ def span(name: str, attributes: Optional[Dict[str, Any]] = None):
         _record(rec)
 
 
+def emit_span(name: str, ts: float, dur: float,
+              attributes: Optional[Dict[str, Any]] = None) -> Optional[dict]:
+    """Record a synthetic complete span for a phase measured elsewhere
+    (streaming-executor op lifetimes, replayed timings). Same opt-in
+    rule as span(): a live parent context counts as opt-in, and the
+    span chains under it."""
+    parent = _ctx.get()
+    if not (is_enabled() or parent is not None):
+        return None
+    rec = {
+        "kind": "span",
+        "name": name,
+        "trace_id": parent["trace_id"] if parent else _new_id(16),
+        "span_id": _new_id(8),
+        "parent_id": parent["span_id"] if parent else None,
+        "ts": float(ts),
+        "dur": float(dur),
+        "attrs": dict(attributes or {}),
+    }
+    _record(rec)
+    return rec
+
+
 @contextlib.contextmanager
 def continue_trace(trace_ctx: Optional[Dict[str, str]], name: str,
                    attributes: Optional[Dict[str, Any]] = None):

@@ -61,6 +61,9 @@ def test_nodes_actors_tasks(dash):
     _, _, body = _get(dash + "/api/v0/tasks?limit=10")
     assert isinstance(json.loads(body), list)
 
+    _, _, body = _get(dash + "/api/v0/edge_stats")
+    assert isinstance(json.loads(body), dict)
+
 
 def test_node_stats_and_metrics(dash):
     import time
@@ -90,8 +93,15 @@ def test_node_stats_and_metrics(dash):
     live = json.loads(body)
     assert live and "available" in next(iter(live.values()))
 
-    status, ctype, body = _get(dash + "/metrics")
-    assert status == 200 and ctype == "text/plain"
+    # the batched TelemetryAgent ships the counter within one
+    # telemetry_report_interval_s — poll instead of assuming sync flush
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, ctype, body = _get(dash + "/metrics")
+        assert status == 200 and ctype == "text/plain"
+        if "dash_test_counter" in body:
+            break
+        time.sleep(0.5)
     assert "dash_test_counter" in body
     # system series derived from the agent pushes
     assert "raytpu_object_store_bytes_in_use" in body

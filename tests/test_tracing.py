@@ -115,8 +115,69 @@ def test_grandchild_task_continues_trace(cluster):
 
     with tracing.span("root") as root:
         assert ray_tpu.get(mid.remote()) == root["trace_id"]
-    leaf_spans = [s for s in _spans() if s["name"] == "task::leaf"]
+    leaf_spans = [s for s in _spans(expect_name="task::leaf")
+                  if s["name"] == "task::leaf"]
     assert leaf_spans and leaf_spans[-1]["trace_id"] == root["trace_id"]
+
+
+def test_trace_chain_task_actor_nested_task(cluster):
+    """One trace across a task -> actor method -> nested task chain,
+    with parent ids linking each hop to the previous one."""
+    @ray_tpu.remote
+    def chain_leaf():
+        return tracing.current_context()["trace_id"]
+
+    @ray_tpu.remote
+    class Hopper:
+        def hop(self):
+            return ray_tpu.get(chain_leaf.remote())
+
+    a = Hopper.remote()
+
+    @ray_tpu.remote
+    def chain_entry(h):
+        return ray_tpu.get(h.hop.remote())
+
+    with tracing.span("chain_root") as root:
+        assert ray_tpu.get(chain_entry.remote(a)) == root["trace_id"]
+    # each hop flushes from a different worker; wait for all three
+    _spans(expect_name="task::chain_entry")
+    _spans(expect_name="actor::hop")
+    spans = _spans(expect_name="task::chain_leaf")
+
+    def latest(name):
+        hits = [s for s in spans if s["name"] == name
+                and s["trace_id"] == root["trace_id"]]
+        assert hits, (name, sorted({s["name"] for s in spans}))
+        return hits[-1]
+
+    entry, hop, leaf = (latest("task::chain_entry"), latest("actor::hop"),
+                        latest("task::chain_leaf"))
+    assert entry["parent_id"] == root["span_id"]
+    assert hop["parent_id"] == entry["span_id"]
+    assert leaf["parent_id"] == hop["span_id"]
+    ray_tpu.kill(a)
+
+
+def test_continue_trace_noop_when_disabled(cluster):
+    """continue_trace with tracing off and no inbound context records
+    nothing and leaves the context untouched; an inbound context still
+    counts as opt-in (that's how workers join a driver's trace)."""
+    tracing.disable()
+    try:
+        before = len(_spans())
+        with tracing.continue_trace(None, "should_not_record") as rec:
+            assert rec is None
+            assert tracing.current_context() is None
+        assert len(_spans()) == before
+        ctx = {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+        with tracing.continue_trace(ctx, "carried_in") as rec:
+            assert rec is not None
+            assert rec["trace_id"] == ctx["trace_id"]
+            assert rec["parent_id"] == ctx["span_id"]
+        assert tracing.current_context() is None  # context restored
+    finally:
+        tracing.enable()
 
 
 def test_span_records_errors(cluster):
