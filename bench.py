@@ -158,14 +158,26 @@ def run_train_bench(preset: str = "debug-125m", batch=None, seq=None,
     }
 
 
-def run_collective_bench(world_sizes=(2, 4), payload_mib=(0.0625, 1.0, 8.0),
-                         backends=("gather", "ring", "hier"), rounds: int = 5,
+def run_collective_bench(world_sizes=(2, 4, 16),
+                         payload_mib=(0.0625, 1.0, 8.0, 64.0),
+                         backends=("gather", "ring", "hier", "auto"),
+                         rounds: int = 5,
                          out_path: str = "BENCH_collective.json"):
     """Sweep host-collective allreduce: payload size x world size x
     backend (ray_tpu.collective). Emits BENCH_collective.json in the
     BENCH_r*.json parsed style; the headline value is the best ring
     bandwidth. Invoked via `python bench.py --bench collective` — slow
-    (spawns world_size worker processes per cell), never part of tier-1.
+    (spawns world_size lane-packed member actors per cell), never part
+    of tier-1.
+
+    Per (world, payload) cell the static backends run first, then
+    ``auto`` — so the auto-selector's agreement round prices its
+    candidates from edge EWMAs the static cells just warmed (the
+    measured path, not priors). ``ring_mailbox`` rows re-run ring with
+    transport="mailbox" (the legacy inline-chunk transport) at the bulk
+    cells, quantifying the zero-copy win. 64 MiB cells are capped at
+    world ≤ 4: the gather funnel would combine world×64 MiB per round
+    through one process, which measures swap, not transport.
     """
     import numpy as np
 
@@ -176,7 +188,7 @@ def run_collective_bench(world_sizes=(2, 4), payload_mib=(0.0625, 1.0, 8.0),
         def __init__(self, rank, world):
             self.rank, self.world = rank, world
 
-        def run(self, backend, group, nbytes, rounds):
+        def run(self, backend, group, nbytes, rounds, transport="auto"):
             import time as _t
 
             import numpy as _np
@@ -184,7 +196,8 @@ def run_collective_bench(world_sizes=(2, 4), payload_mib=(0.0625, 1.0, 8.0),
             from ray_tpu import collective as col
 
             col.init_collective_group(self.world, self.rank, group,
-                                      backend=backend, timeout_s=120)
+                                      backend=backend, timeout_s=300,
+                                      transport=transport)
             x = _np.ones(max(1, nbytes // 8), dtype=_np.float64)
             col.allreduce(x, group)              # warm the path
             col.reset_transfer_stats(group)
@@ -193,10 +206,52 @@ def run_collective_bench(world_sizes=(2, 4), payload_mib=(0.0625, 1.0, 8.0),
                 t0 = _t.perf_counter()
                 col.allreduce(x, group)
                 times.append(_t.perf_counter() - t0)
-            stats = col.transfer_stats(group)
+            gs = col.group_stats(group)
             col.barrier(group)
+            chosen = sorted({d["backend"]
+                             for k, d in gs["decisions"].items()
+                             if k.startswith("allreduce")})
             return {"median_s": sorted(times)[len(times) // 2],
-                    "bytes_sent": stats["bytes_sent"] / rounds}
+                    "bytes_sent": gs["transfer"]["bytes_sent"] / rounds,
+                    "zc_sends": gs["transfer"]["zc_sends"],
+                    "chosen": chosen}
+
+    def _run_cell(backend, world, mib, transport, label):
+        nbytes = int(mib * (1 << 20))
+        group = f"bench_{label}_{world}_{nbytes}"
+        members = [_BenchMember.options(num_cpus=0.25).remote(i, world)
+                   for i in range(world)]
+        r = rounds if mib < 64 else max(3, rounds - 2)
+        cell = {"backend": label, "world": world, "payload_mib": mib,
+                "transport": transport}
+        try:
+            outs = ray_tpu.get(
+                [m.run.remote(backend, group, nbytes, r, transport)
+                 for m in members], timeout=900)
+            med = max(o["median_s"] for o in outs)
+            cell.update({
+                "median_s": round(med, 6),
+                "mib_per_s": round(mib / max(med, 1e-9), 2),
+                "bytes_sent_per_rank": max(o["bytes_sent"] for o in outs),
+                "zero_copy": any(o["zc_sends"] > 0 for o in outs),
+            })
+            if backend == "auto":
+                cell["chosen"] = outs[0]["chosen"]
+        except Exception as e:  # noqa: BLE001 — sweep must finish
+            cell["error"] = str(e)[:200]
+        finally:
+            from ray_tpu import collective as col
+
+            try:
+                col.destroy_collective_group(group)
+            except Exception:
+                pass
+            for m in members:
+                try:
+                    ray_tpu.kill(m)
+                except Exception:
+                    pass
+        return cell
 
     # Explicit CPU budget: auto-detection on a 1-core box would admit a
     # single 1.0-CPU slot and the member actors could never all schedule.
@@ -205,39 +260,42 @@ def run_collective_bench(world_sizes=(2, 4), payload_mib=(0.0625, 1.0, 8.0),
     sweep = []
     for world in world_sizes:
         for mib in payload_mib:
-            nbytes = int(mib * (1 << 20))
+            if mib >= 64 and world > 4:
+                continue
+            # static backends first, "auto" last: its selection round
+            # then prices candidates from freshly-warmed edge EWMAs
             for backend in backends:
-                group = f"bench_{backend}_{world}_{nbytes}"
-                members = [_BenchMember.options(num_cpus=0.25).remote(i, world)
-                           for i in range(world)]
-                try:
-                    outs = ray_tpu.get(
-                        [m.run.remote(backend, group, nbytes, rounds)
-                         for m in members], timeout=600)
-                    med = max(o["median_s"] for o in outs)
-                    sweep.append({
-                        "backend": backend, "world": world,
-                        "payload_mib": mib,
-                        "median_s": round(med, 6),
-                        "mib_per_s": round(mib / max(med, 1e-9), 2),
-                        "bytes_sent_per_rank": max(o["bytes_sent"]
-                                                   for o in outs),
-                    })
-                except Exception as e:  # noqa: BLE001 — sweep must finish
-                    sweep.append({"backend": backend, "world": world,
-                                  "payload_mib": mib, "error": str(e)[:200]})
-                finally:
-                    from ray_tpu import collective as col
+                sweep.append(_run_cell(backend, world, mib, "auto", backend))
+            if mib >= 1 and world <= 4:
+                # legacy-transport comparison rows (the zero-copy claim)
+                sweep.append(_run_cell("ring", world, mib, "mailbox",
+                                       "ring_mailbox"))
 
-                    try:
-                        col.destroy_collective_group(group)
-                    except Exception:
-                        pass
-                    for m in members:
-                        try:
-                            ray_tpu.kill(m)
-                        except Exception:
-                            pass
+    def _cells(**kv):
+        return [c for c in sweep if "mib_per_s" in c
+                and all(c.get(k) == v for k, v in kv.items())]
+
+    # auto-vs-best-static and zero-copy-vs-mailbox acceptance summaries
+    auto_checks, zc_speedups = [], {}
+    for world in world_sizes:
+        for mib in payload_mib:
+            statics = [c for c in _cells(world=world, payload_mib=mib)
+                       if c["backend"] in ("gather", "ring", "hier")]
+            auto = _cells(world=world, payload_mib=mib, backend="auto")
+            if statics and auto:
+                best = max(c["mib_per_s"] for c in statics)
+                got = auto[0]["mib_per_s"]
+                auto_checks.append({
+                    "world": world, "payload_mib": mib,
+                    "auto_mib_per_s": got, "best_static_mib_per_s": best,
+                    "auto_within_15pct": bool(got >= 0.85 * best),
+                    "chosen": auto[0].get("chosen")})
+            mb = _cells(world=world, payload_mib=mib, backend="ring_mailbox")
+            zc = _cells(world=world, payload_mib=mib, backend="ring")
+            if mb and zc:
+                zc_speedups[f"w{world}_{mib}mib"] = round(
+                    zc[0]["mib_per_s"] / max(mb[0]["mib_per_s"], 1e-9), 2)
+
     ring_bw = [c["mib_per_s"] for c in sweep
                if c.get("backend") == "ring" and "mib_per_s" in c]
     result = {
@@ -246,9 +304,12 @@ def run_collective_bench(world_sizes=(2, 4), payload_mib=(0.0625, 1.0, 8.0),
         "unit": "MiB/s",
         "vs_baseline": None,
         "extra": {"sweep": sweep, "rounds": rounds,
+                  "auto_vs_best_static": auto_checks,
+                  "zerocopy_vs_mailbox_ring_speedup": zc_speedups,
                   "note": "host allreduce bandwidth per backend; "
                           "bytes_sent_per_rank shows ring's 2(N-1)/N "
-                          "vs gather's full-payload fan-in"},
+                          "vs gather's full-payload fan-in; ring_mailbox "
+                          "rows force the legacy inline transport"},
     }
     with open(out_path, "w") as f:
         json.dump(result, f, indent=2)

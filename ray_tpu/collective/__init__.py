@@ -31,7 +31,7 @@ from ray_tpu.collective.api import (GroupClient, allgather, allgather_async,
                                     coordinator_stats,
                                     destroy_collective_group,
                                     get_collective_group_size,
-                                    get_group_topology, get_rank,
+                                    get_group_topology, get_rank, group_stats,
                                     init_collective_group, reducescatter,
                                     reducescatter_async, reset_transfer_stats,
                                     transfer_stats)
@@ -47,6 +47,7 @@ __all__ = [
     "reducescatter_async", "barrier_async",
     "get_rank", "get_collective_group_size", "get_group_topology",
     "transfer_stats", "reset_transfer_stats", "coordinator_stats",
+    "group_stats",
     "available_backends", "register_backend", "select_backend",
     "CollectiveError", "CollectiveTimeoutError", "Topology", "GroupClient",
 ]

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.collective import cost as _cost
 from ray_tpu.collective import pytree as _pt
 from ray_tpu.util import tracing as _tracing
 from ray_tpu.collective.errors import CollectiveError, CollectiveTimeoutError
@@ -72,15 +73,24 @@ _hooks.append(_on_actor_teardown)
 class GroupClient:
     """One rank's membership in one collective group."""
 
+    #: A cached backend decision is re-priced after this many uses —
+    #: frequent enough to track edge-model drift within a workload,
+    #: rare enough that the agreement round's coordinator RTT amortizes.
+    REFRESH_EVERY = 64
+
     def __init__(self, name: str, world_size: int, rank: int,
                  backend: str = "auto", timeout_s: float = 60.0,
-                 pipeline_chunks: int = 4):
+                 pipeline_chunks: int = 4, transport: str = "auto"):
         if backend != "auto":
             get_backend_factory(backend)     # fail fast on unknown names
-        self.ctx = GroupContext(name, world_size, rank, timeout_s=timeout_s)
+        self.ctx = GroupContext(name, world_size, rank, timeout_s=timeout_s,
+                                transport=transport)
         self.requested_backend = backend
         self.pipeline_chunks = pipeline_chunks
         self._instances: Dict[str, Any] = {}
+        #: (op, payload log2-bucket) → agreed decision dict. Identical on
+        #: every rank by construction (rank 0 broadcasts its choice).
+        self._decisions: Dict[tuple, dict] = {}
         self._op_lock = threading.Lock()     # serializes sync vs async ops
         self._executor: Optional[ThreadPoolExecutor] = None
 
@@ -102,11 +112,7 @@ class GroupClient:
     def topology(self) -> Topology:
         return self.ctx.topology
 
-    def _backend(self, op: str, payload_bytes: Optional[int] = None):
-        name = self.requested_backend
-        if name == "auto":
-            name = select_backend(op, self.world, self.ctx.topology,
-                                  payload_bytes)
+    def _instance(self, name: str):
         inst = self._instances.get(name)
         if inst is None:
             factory = get_backend_factory(name)
@@ -116,6 +122,50 @@ class GroupClient:
                 inst = factory(self.ctx)
             self._instances[name] = inst
         return inst
+
+    def _choose(self, op: str, payload_bytes: Optional[int] = None):
+        """(backend name, decision info) for one op call.
+
+        With backend="auto" the choice comes from the measured cost
+        model, agreed across ranks: rank 0 prices the candidates with
+        ITS edge-stats snapshot and coordinator EWMA and broadcasts the
+        result — per-rank snapshot drift can never split the group
+        across backends. Decisions cache per (op, payload bucket) so the
+        agreement RTT amortizes; every rank's cache and use counters
+        advance in lockstep (same op stream), so refreshes line up too."""
+        if self.requested_backend != "auto":
+            return self.requested_backend, {
+                "backend": self.requested_backend, "source": "requested"}
+        key = (op, _cost.payload_bucket(payload_bytes))
+        dec = self._decisions.get(key)
+        if dec is not None and dec["uses"] < self.REFRESH_EVERY:
+            dec["uses"] += 1
+            return dec["backend"], dec
+        dec = self._agree(op, payload_bytes)
+        dec["uses"] = 1
+        self._decisions[key] = dec
+        return dec["backend"], dec
+
+    def _agree(self, op: str, payload_bytes: Optional[int]) -> dict:
+        ctx = self.ctx
+        if self.world == 1:
+            _, info = _cost.choose_backend(op, 1, ctx.topology, payload_bytes)
+            return dict(info)
+        chosen = None
+        if ctx.rank == 0:
+            try:
+                from ray_tpu.observability.edges import edge_stats
+
+                edges = edge_stats()
+            except Exception:
+                edges = {}
+            _, info = _cost.choose_backend(
+                op, self.world, ctx.topology, payload_bytes, edges=edges,
+                coord_lat=ctx.coord_lat_ewma, coord_bw=ctx.coord_bw_ewma)
+            chosen = dict(info)
+        # one coordinator RTT ties the round; every rank must pass here
+        # (same op stream), so this cannot deadlock
+        return dict(ctx.coord_exchange("broadcast", chosen))
 
     def _submit(self, fn, *args) -> Future:
         if self._executor is None:
@@ -132,37 +182,55 @@ class GroupClient:
 
     # -- ops -------------------------------------------------------------
 
-    def _span(self, op: str):
+    def _span(self, op: str, decision: Optional[dict] = None):
         """Collective rounds are timeline spans (no-op when tracing is
         off) — they land in the recording worker's lane next to its
-        tasks."""
-        return _tracing.span(f"collective::{op}",
-                             {"group": self.name, "rank": self.rank,
-                              "world": self.world})
+        tasks, carrying the auto-selector's decision."""
+        args = {"group": self.name, "rank": self.rank, "world": self.world}
+        if decision:
+            args["backend"] = decision.get("backend")
+            args["decision_source"] = decision.get("source")
+            costs = decision.get("costs_ms")
+            if costs:
+                args["predicted_cost_ms"] = costs.get(decision.get("backend"))
+        return _tracing.span(f"collective::{op}", args)
 
     def allreduce(self, tensor):
-        with self._op_lock, self._span("allreduce"):
+        with self._op_lock:
             if _pt.is_leaf(tensor):
                 arr = np.asarray(tensor)
-                return self._backend("allreduce", arr.nbytes).allreduce(arr)
+                name, dec = self._choose("allreduce", arr.nbytes)
+                with self._span("allreduce", dec):
+                    return self._instance(name).allreduce(arr)
             leaves, treedef = _pt.tree_flatten(tensor)
             buffers, layout = _pt.pack_leaves(leaves)
-            reduced = [self._backend("allreduce", b.nbytes).allreduce(b)
-                       for b in buffers]
-            return _pt.tree_unflatten(treedef,
-                                      _pt.unpack_leaves(reduced, layout))
+            name, dec = self._choose(
+                "allreduce", buffers[0].nbytes if buffers else None)
+            with self._span("allreduce", dec):
+                # per-buffer choice (packed buffers differ in size); the
+                # duplicate first-buffer _choose is a cache hit and every
+                # rank repeats it identically, so counters stay in step
+                reduced = [
+                    self._instance(self._choose("allreduce", b.nbytes)[0])
+                    .allreduce(b) for b in buffers]
+                return _pt.tree_unflatten(
+                    treedef, _pt.unpack_leaves(reduced, layout))
 
     def allgather(self, value) -> List[Any]:
-        with self._op_lock, self._span("allgather"):
-            return self._backend("allgather").allgather(value)
+        with self._op_lock:
+            name, dec = self._choose("allgather")
+            with self._span("allgather", dec):
+                return self._instance(name).allgather(value)
 
     def broadcast(self, value, src_rank: int = 0):
         if not (0 <= src_rank < self.world):
             raise ValueError(f"broadcast: src_rank {src_rank} outside "
                              f"world of {self.world}")
-        with self._op_lock, self._span("broadcast"):
-            data = value if self.rank == src_rank else None
-            return self._backend("broadcast").broadcast(data, src_rank)
+        with self._op_lock:
+            name, dec = self._choose("broadcast")
+            with self._span("broadcast", dec):
+                data = value if self.rank == src_rank else None
+                return self._instance(name).broadcast(data, src_rank)
 
     def reducescatter(self, tensor) -> np.ndarray:
         arr = np.asarray(tensor)
@@ -176,12 +244,16 @@ class GroupClient:
                 f"reducescatter: leading dim {arr.shape[0]} is not "
                 f"divisible by world_size {self.world}; pad the payload "
                 "or pick a scatterable batch dimension")
-        with self._op_lock, self._span("reducescatter"):
-            return self._backend("reducescatter", arr.nbytes).reducescatter(arr)
+        with self._op_lock:
+            name, dec = self._choose("reducescatter", arr.nbytes)
+            with self._span("reducescatter", dec):
+                return self._instance(name).reducescatter(arr)
 
     def barrier(self) -> None:
-        with self._op_lock, self._span("barrier"):
-            self._backend("barrier").barrier()
+        with self._op_lock:
+            name, dec = self._choose("barrier")
+            with self._span("barrier", dec):
+                self._instance(name).barrier()
 
     def destroy(self):
         self.close_local()
@@ -197,16 +269,24 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default", *,
                           backend: str = "auto",
                           timeout_s: float = 60.0,
-                          pipeline_chunks: int = 4) -> None:
+                          pipeline_chunks: int = 4,
+                          transport: str = "auto") -> None:
     """Join `group_name` as `rank` of `world_size` (ref: collective.py:120).
 
     backend: "auto" | "gather" | "ring" | "hier" | any registered name.
     timeout_s: per-round deadline before surviving ranks raise
         ``CollectiveTimeoutError`` (member-failure detection).
+    transport: "auto" (Config-threshold tiering: inline below the eager
+        threshold, zero-copy object-store refs above the zero-copy
+        threshold) | "mailbox" (force everything inline+chunked — the
+        legacy transport) | "zerocopy" (force every ndarray/bytes chunk
+        through the store) | "eager" (force single inline messages).
+        Every rank of a group must pass the same value.
     """
     _groups[(_ctx(), group_name)] = GroupClient(
         group_name, world_size, rank, backend=backend,
-        timeout_s=timeout_s, pipeline_chunks=pipeline_chunks)
+        timeout_s=timeout_s, pipeline_chunks=pipeline_chunks,
+        transport=transport)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
@@ -332,3 +412,31 @@ def coordinator_stats(group_name: str = "default") -> dict:
     """The gather coordinator's fan-in accounting (bytes_in)."""
     g = _group(group_name)
     return ray_tpu.get(g.ctx.coord.stats.remote(), timeout=30)
+
+
+def group_stats(group_name: str = "default") -> dict:
+    """This rank's full collective picture: transfer accounting, the
+    transport tiering in effect, and every auto-selection decision (the
+    chosen backend + the cost model's predictions behind it)."""
+    g = _group(group_name)
+    ctx = g.ctx
+    return {
+        "group": g.name,
+        "rank": g.rank,
+        "world": g.world,
+        "requested_backend": g.requested_backend,
+        "transfer": ctx.stats.snapshot(),
+        "transport": {
+            "mode": ctx.transport,
+            "eager_threshold_bytes": ctx.eager_threshold,
+            "zerocopy_threshold_bytes": ctx.zc_threshold,
+            "zc_inflight_chunks": len(ctx._zc_inflight),
+            "zc_inflight_bytes": ctx._zc_bytes,
+        },
+        "coordinator_model": {
+            "latency_ewma_s": ctx.coord_lat_ewma,
+            "bandwidth_ewma_bps": ctx.coord_bw_ewma,
+        },
+        "decisions": {f"{op}@{bucket}": dict(d)
+                      for (op, bucket), d in g._decisions.items()},
+    }

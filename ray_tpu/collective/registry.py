@@ -5,25 +5,29 @@ with the op surface (allreduce/allgather/broadcast/reducescatter/
 barrier). Third parties can plug in via ``register_backend`` — e.g. a
 future RDMA or grpc transport — without touching the API layer.
 
-``"auto"`` picks per call site:
+``"auto"`` prices each candidate backend with the measured cost model
+(cost.py: hops × edge latency + bytes / edge bandwidth from the
+observability/edges EWMA stats, priors until edges warm) and picks the
+cheapest — small payloads still land on ``gather`` (one coordinator RTT
+beats 2(N−1) ring hops when latency dominates), bulk multi-node on
+``hier`` (only node leaders pay the inter-node price), bulk single-node
+on ``ring`` — but now because the model says so on this cluster, not
+because a static world-size threshold guessed it.
 
-- tiny worlds (≤ 2) and small payloads (< 64 KiB) → ``gather`` — one
-  coordinator RTT beats 2(N−1) ring hops when latency dominates;
-- large payloads spanning nodes → ``hier`` — only node leaders pay the
-  inter-node (DCN-analog) price;
-- large payloads on one node → ``ring`` — bandwidth-optimal, no
-  single-process fan-in.
-
-Selection inputs must be identical on every rank: world size and
-topology always are; payload bytes are used only for ops whose payload
-shape is required to match across ranks (allreduce/reducescatter).
+Selection inputs must be identical on every rank: ``select_backend``
+here is deterministic in its arguments, and the dispatch path
+(api.GroupClient) has rank 0 compute the choice with ITS edge snapshot
+and broadcast it, so per-rank snapshot drift can never split a group
+across backends.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
 
-#: Payloads below this take the single-RTT coordinator path under "auto".
+#: Historic small-payload cutoff; still the default of the Config knob
+#: ``collective_eager_threshold_bytes`` (the inline-transport tier), no
+#: longer a backend-selection threshold.
 SMALL_PAYLOAD_BYTES = 64 * 1024
 
 _BACKENDS: Dict[str, Callable] = {}
@@ -60,18 +64,14 @@ _register_defaults()
 
 
 def select_backend(op: str, world_size: int, topology,
-                   payload_bytes: Optional[int] = None) -> str:
-    """Resolve "auto" to a concrete backend name for one op call."""
-    if world_size <= 2:
-        return "gather"
-    if op in ("allreduce", "reducescatter"):
-        if payload_bytes is not None and payload_bytes < SMALL_PAYLOAD_BYTES:
-            return "gather"
-        if topology is not None and topology.multi_node:
-            return "hier"
-        return "ring"
-    if op == "allgather":
-        return "ring"
-    if op == "broadcast":
-        return "ring"          # tree broadcast: log N depth, no fan-in
-    return "gather"            # barrier and anything latency-bound
+                   payload_bytes: Optional[int] = None,
+                   edges: Optional[Dict[str, dict]] = None) -> str:
+    """Resolve "auto" to a concrete backend name for one op call by
+    pricing the candidates (cost.py). Deterministic in its arguments;
+    pass the same `edges` snapshot on every rank (or let the api layer's
+    rank-0 agreement round do it for you)."""
+    from ray_tpu.collective import cost
+
+    name, _ = cost.choose_backend(op, world_size, topology, payload_bytes,
+                                  edges=edges)
+    return name

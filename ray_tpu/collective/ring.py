@@ -3,10 +3,13 @@
 Allreduce = reduce-scatter + all-gather around a logical ring
 (Patarasuk & Yuan 2009): each rank sends 2·(N−1)/N of the payload total
 — independent of N — instead of the gather backend's N× fan-in through
-one coordinator. Every per-step block is further split into
-``pipeline_chunks`` sub-chunks whose sends are all issued before the
-first receive is drained, so the object-store transport overlaps with
-the local accumulate (chunked pipelining).
+one coordinator. A per-step block in the inline-mailbox band is further
+split into ``pipeline_chunks`` sub-chunks whose sends are all issued
+before the first receive is drained, so transport overlaps with the
+local accumulate (chunked pipelining); blocks in the zero-copy band go
+as one store object per step, and in the all-gather phase the SAME
+ObjectRef is forwarded hop-to-hop instead of re-staged (see
+``ring_allreduce_flat`` phase 2).
 
 Broadcast and barrier use a binary tree (log N rounds) rather than the
 ring — latency-bound ops don't benefit from ring bandwidth.
@@ -45,6 +48,26 @@ def _sub_bounds(lo: int, hi: int, parts: int) -> List[Tuple[int, int]]:
     return [(lo + a, lo + b) for a, b in _bounds(n, parts)]
 
 
+def _wire_subchunks(ctx: GroupContext, lo: int, hi: int, itemsize: int,
+                    pipeline_chunks: int) -> List[Tuple[int, int]]:
+    """Sub-chunk bounds for one per-step block, honoring the transport
+    tiers: a block below ``collective_eager_threshold_bytes`` goes as ONE
+    inline message — at small sizes the per-chunk fixed costs (actor RPC
+    + pickle) dominate and pipelining only multiplies them — and a block
+    big enough for the zero-copy tier ALSO goes as one piece, because the
+    object store already decouples transfer from the mailbox rendezvous
+    (sub-chunking a ref-mailed block would just multiply put/take/ack
+    round-trips). Pipelining earns its keep only in the middle (inline
+    mailbox) band. Sender and receiver compute this from identical
+    sizes, so keys agree across ranks."""
+    block = (hi - lo) * itemsize
+    if block < ctx.eager_threshold:
+        return [(lo, hi if hi > lo else lo)]
+    if ctx.zc_threshold is not None and block >= ctx.zc_threshold:
+        return [(lo, hi)]
+    return _sub_bounds(lo, hi, pipeline_chunks)
+
+
 def ring_allreduce_flat(ctx: GroupContext, buf: np.ndarray,
                         ring_ranks: Sequence[int], tag: str,
                         pipeline_chunks: int = 4) -> np.ndarray:
@@ -66,27 +89,52 @@ def ring_allreduce_flat(ctx: GroupContext, buf: np.ndarray,
     for step in range(n - 1):
         send_c = (pos - 1 - step) % n
         recv_c = (pos - 2 - step) % n
-        send_subs = _sub_bounds(*chunks[send_c], pipeline_chunks)
-        recv_subs = _sub_bounds(*chunks[recv_c], pipeline_chunks)
-        for i, (a, b) in enumerate(send_subs):
-            ctx.send(right, f"{tag}:rs:{step}:{i}", buf[a:b])
+        send_subs = _wire_subchunks(ctx, *chunks[send_c], buf.itemsize,
+                                    pipeline_chunks)
+        recv_subs = _wire_subchunks(ctx, *chunks[recv_c], buf.itemsize,
+                                    pipeline_chunks)
+        ctx.send_many(right, [(f"{tag}:rs:{step}:{i}", buf[a:b])
+                              for i, (a, b) in enumerate(send_subs)])
         for i, (a, b) in enumerate(recv_subs):
             part = ctx.recv(left, f"{tag}:rs:{step}:{i}", op="allreduce")
             if b > a:
                 buf[a:b] += part
 
-    # phase 2 — all-gather: circulate the reduced chunks
+    # phase 2 — all-gather: circulate the reduced chunks. A zero-copy
+    # chunk is put() into the store ONCE by its owner (hops=n-1) and the
+    # same ObjectRef is forward()ed around the ring — the n-1 re-puts
+    # (and their memcpys + pin RPCs) the naive loop would pay collapse
+    # into envelope relays; only the final hop acks the owner.
+    held: Dict[int, dict] = {}
     for step in range(n - 1):
         send_c = (pos - step) % n
         recv_c = (pos - step - 1) % n
-        send_subs = _sub_bounds(*chunks[send_c], pipeline_chunks)
-        recv_subs = _sub_bounds(*chunks[recv_c], pipeline_chunks)
-        for i, (a, b) in enumerate(send_subs):
-            ctx.send(right, f"{tag}:ag:{step}:{i}", buf[a:b])
+        send_subs = _wire_subchunks(ctx, *chunks[send_c], buf.itemsize,
+                                    pipeline_chunks)
+        recv_subs = _wire_subchunks(ctx, *chunks[recv_c], buf.itemsize,
+                                    pipeline_chunks)
+        if step == 0:
+            ctx.send_many(right, [(f"{tag}:ag:{step}:{i}", buf[a:b])
+                                  for i, (a, b) in enumerate(send_subs)],
+                          hops=n - 1)
+        else:
+            inline = []
+            for i, (a, b) in enumerate(send_subs):
+                env = held.get(i)
+                if env is not None:
+                    ctx.forward(right, f"{tag}:ag:{step}:{i}", env)
+                else:
+                    inline.append((f"{tag}:ag:{step}:{i}", buf[a:b]))
+            if inline:
+                ctx.send_many(right, inline)
+        held = {}
         for i, (a, b) in enumerate(recv_subs):
-            part = ctx.recv(left, f"{tag}:ag:{step}:{i}", op="allreduce")
+            part, env = ctx.recv_fwd(left, f"{tag}:ag:{step}:{i}",
+                                     op="allreduce")
             if b > a:
                 buf[a:b] = part
+            if env is not None and int(env.get("hops", 1)) > 1:
+                held[i] = env
     return buf
 
 
@@ -105,10 +153,12 @@ def ring_reducescatter_flat(ctx: GroupContext, buf: np.ndarray,
     for step in range(n - 1):
         send_c = (pos - 1 - step) % n
         recv_c = (pos - 2 - step) % n
-        send_subs = _sub_bounds(*chunks[send_c], pipeline_chunks)
-        recv_subs = _sub_bounds(*chunks[recv_c], pipeline_chunks)
-        for i, (a, b) in enumerate(send_subs):
-            ctx.send(right, f"{tag}:rs:{step}:{i}", buf[a:b])
+        send_subs = _wire_subchunks(ctx, *chunks[send_c], buf.itemsize,
+                                    pipeline_chunks)
+        recv_subs = _wire_subchunks(ctx, *chunks[recv_c], buf.itemsize,
+                                    pipeline_chunks)
+        ctx.send_many(right, [(f"{tag}:rs:{step}:{i}", buf[a:b])
+                              for i, (a, b) in enumerate(send_subs)])
         for i, (a, b) in enumerate(recv_subs):
             part = ctx.recv(left, f"{tag}:rs:{step}:{i}", op="reducescatter")
             if b > a:

@@ -113,6 +113,18 @@ class Config:
     # direct_task_transport.cc pipelining). Sequential submit->get loops
     # go from 3 RPCs/task to 1.
     lease_reuse_grace_s: float = 0.025
+    # --- host collectives (ray_tpu/collective/) -----------------------------
+    # Per-hop blocks below this go as ONE inline mailbox message with no
+    # chunking or sub-chunk pipelining — at small sizes the per-chunk
+    # fixed costs (actor RPC + pickle) dominate and pipelining only
+    # multiplies them (the eager tier).
+    collective_eager_threshold_bytes: int = 64 * 1024
+    # Chunks at or above this are put() into the object store once and
+    # only the ObjectRef is mailed; the receiver resolves it via the
+    # pinned zero-copy local read (the zero-copy tier). Must stay above
+    # max_direct_call_object_size or the "store" copy is just an inline
+    # blob riding the ref. 0 disables (everything rides the mailbox).
+    collective_zerocopy_threshold_bytes: int = 256 * 1024
     # --- tpu ----------------------------------------------------------------
     # Logical chip resource name; slice-aware gang scheduling reserves whole
     # ICI-connected shapes (SURVEY.md section 7 "hard parts").

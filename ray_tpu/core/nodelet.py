@@ -970,6 +970,14 @@ class Nodelet:
         self.primary_pins.add(oid)
         return {"ok": True}
 
+    async def rpc_pin_objects(self, oids: List[ObjectID]) -> dict:
+        """Batched rpc_pin_object: one RPC pins a whole wave of primaries.
+        The collective zero-copy transport puts pipeline_chunks sub-chunk
+        objects per ring step; pinning them individually would pay one
+        owner->nodelet round-trip per sub-chunk on the hot path."""
+        results = [(await self.rpc_pin_object(oid))["ok"] for oid in oids]
+        return {"ok": all(results), "pinned": sum(results)}
+
     async def _restore_local(self, oid: ObjectID) -> bool:
         """Disk → shm (ref: restore_spilled_object). False if absent/full."""
         if self.spill is None or not self.spill.contains(oid):

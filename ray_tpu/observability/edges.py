@@ -24,6 +24,18 @@ from typing import Dict, Optional, Tuple
 # round does not whipsaw the auto-selector.
 EWMA_ALPHA = 0.25
 
+# Size bands for the two EWMAs. A transfer below LAT_BAND_BYTES is
+# latency-dominated: its duration estimates the per-hop fixed cost, but
+# bytes/seconds on it is rendezvous noise, not link bandwidth. A
+# transfer at/above BW_BAND_BYTES is bytes-dominated: its rate estimates
+# bandwidth, but folding its duration into the latency EWMA would charge
+# every future small hop a megabyte's copy time. Mid-band transfers
+# update neither EWMA (they still count toward totals); consumers fall
+# back to class priors per-component when a band has no observations yet
+# (collective/cost.py:_edge_link).
+LAT_BAND_BYTES = 64 * 1024
+BW_BAND_BYTES = 256 * 1024
+
 
 class EdgeModel:
     """EWMA latency/bandwidth per directed (src_node, dst_node) edge."""
@@ -48,10 +60,12 @@ class EdgeModel:
         e["kinds"][kind] = e["kinds"].get(kind, 0) + 1
         e["last_ts"] = time.time()
         a = self.alpha
-        prev_lat = e["latency_ewma_s"]
-        e["latency_ewma_s"] = (float(seconds) if prev_lat is None
-                               else a * float(seconds) + (1 - a) * prev_lat)
-        if nbytes > 0 and seconds > 0:
+        if nbytes < LAT_BAND_BYTES:
+            prev_lat = e["latency_ewma_s"]
+            e["latency_ewma_s"] = (
+                float(seconds) if prev_lat is None
+                else a * float(seconds) + (1 - a) * prev_lat)
+        if nbytes >= BW_BAND_BYTES and nbytes > 0 and seconds > 0:
             bw = float(nbytes) / float(seconds)
             prev_bw = e["bandwidth_ewma_bps"]
             e["bandwidth_ewma_bps"] = (bw if prev_bw is None

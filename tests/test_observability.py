@@ -185,7 +185,7 @@ def test_histogram_tagged_series_render_separately():
 
 
 def test_edge_model_ewma():
-    from ray_tpu.observability.edges import EdgeModel
+    from ray_tpu.observability.edges import BW_BAND_BYTES, EdgeModel
 
     m = EdgeModel()
     m.observe("a", "b", 1000, 0.1, kind="object_pull")
@@ -195,14 +195,23 @@ def test_edge_model_ewma():
     assert s["bytes_total"] == 2000.0
     # alpha=0.25: 0.25*0.3 + 0.75*0.1
     assert s["latency_ewma_s"] == pytest.approx(0.15)
+    # size-banded: a small transfer's bytes/seconds is rendezvous noise,
+    # so it must never touch the bandwidth EWMA
+    assert s["bandwidth_ewma_bps"] is None
+    # bulk observations update bandwidth only; latency EWMA unchanged
+    nb = BW_BAND_BYTES
+    m.observe("a", "b", nb, 1.0, kind="object_pull")
+    m.observe("a", "b", nb, 3.0, kind="object_pull")
+    s = m.stats()["a->b"]
+    assert s["latency_ewma_s"] == pytest.approx(0.15)
     assert s["bandwidth_ewma_bps"] == pytest.approx(
-        0.25 * (1000 / 0.3) + 0.75 * (1000 / 0.1))
-    assert s["kinds"] == {"object_pull": 2}
+        0.25 * (nb / 3.0) + 0.75 * (nb / 1.0))
+    assert s["kinds"] == {"object_pull": 4}
     # malformed observations are ignored, never raise
     m.observe("", "b", 1, 0.1)
     m.observe("a", None, 1, 0.1)
     m.observe("a", "b", 1, -1.0)
-    assert m.stats()["a->b"]["count"] == 2
+    assert m.stats()["a->b"]["count"] == 4
 
 
 def test_record_transfer_without_runtime_is_noop():
@@ -228,22 +237,28 @@ def test_edge_stats_after_collective(ray_start_regular):
 
             col.init_collective_group(2, self.rank, group, backend="ring",
                                       timeout_s=60)
+            # 32KiB payload: 16KiB inline chunks feed the latency band;
+            # 1MiB payload: 512KiB zero-copy chunks feed the bandwidth
+            # band (the EWMAs are size-banded, observability/edges.py)
             x = col.allreduce(np.ones(4096, dtype=np.float64), group)
+            y = col.allreduce(np.ones(131072, dtype=np.float64), group)
             ray_tpu._rt.get_runtime().flush_task_events(wait=True)
-            return float(x[0])
+            return float(x[0] + y[0])
 
     members = [Member.options(num_cpus=0.25).remote(i) for i in range(2)]
     try:
         out = ray_tpu.get([m.run.remote("obs_edges") for m in members],
                           timeout=120)
-        assert out == [2.0, 2.0]
+        assert out == [4.0, 4.0]
         edges = state.edge_stats()
         assert edges, "allreduce produced no edge observations"
-        e = next(iter(edges.values()))
+        coll = [e for e in edges.values()
+                if e["kinds"].get("collective", 0) >= 1]
+        assert coll, "no collective edge observations"
+        e = max(coll, key=lambda d: d["count"])
         assert e["count"] >= 1
         assert e["latency_ewma_s"] > 0
         assert e["bandwidth_ewma_bps"] > 0
-        assert e["kinds"].get("collective", 0) >= 1
     finally:
         from ray_tpu import collective as col
 
