@@ -129,6 +129,26 @@ class Config:
     # Logical chip resource name; slice-aware gang scheduling reserves whole
     # ICI-connected shapes (SURVEY.md section 7 "hard parts").
     chip_resource: str = "TPU"
+    # --- LLM serving (ray_tpu/serve/llm_router.py) --------------------------
+    # Prompt tokens hashed for prefix-affinity routing: streams sharing at
+    # least this many leading tokens rendezvous onto the same replica, so
+    # its paged-KV prefix cache (llm.py PrefixCache) actually gets hits.
+    llm_router_prefix_tokens: int = 32
+    # Router-wide in-flight bound; admissions beyond it shed with
+    # LLMQueueFull + Retry-After instead of queueing unboundedly.
+    llm_router_max_inflight: int = 256
+    # Affinity override point: when the prefix-preferred replica's
+    # pressure exceeds overload_factor x the fleet mean, fall through to
+    # the next replica in rendezvous order (cache locality is not worth
+    # an unbounded hot spot).
+    llm_router_overload_factor: float = 2.0
+    # Background poll period for per-replica LLMServer.stats() feeding
+    # the pressure score (busy-fraction EWMA).
+    llm_router_stats_interval_s: float = 1.0
+    # Scale-down grace: a draining replica is unpublished from routers
+    # immediately, then given this long to finish in-flight streams
+    # before the controller kills it.
+    serve_drain_timeout_s: float = 10.0
     # --- observability ------------------------------------------------------
     task_event_buffer_size: int = 10000          # ref: task_event_buffer.h:199
     metrics_report_interval_s: float = 5.0       # nodelet node-stats agent

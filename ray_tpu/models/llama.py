@@ -26,6 +26,8 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.parallel import _compat  # noqa: F401 — installs jax.shard_map
+
 
 @dataclass(frozen=True)
 class LlamaConfig:

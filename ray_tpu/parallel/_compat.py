@@ -1,0 +1,122 @@
+"""JAX API-drift shims: one place that papers over shard_map's move.
+
+``shard_map`` graduated out of ``jax.experimental.shard_map`` and changed
+shape on the way: the new ``jax.shard_map`` is keyword-only, spells the
+replication check ``check_vma`` (was ``check_rep``), and expresses
+partial-manual lowering as ``axis_names={...}`` (the axes that ARE manual)
+where the legacy function took ``auto=frozenset(...)`` (the axes that are
+NOT). The sibling explicit-sharding API (``jax.sharding.AxisType``,
+``jax.make_mesh(..., axis_types=...)``) is likewise absent on older
+releases. All ray_tpu kernels and tests are written against the NEW
+surface; on an old jax this module installs adapters onto the ``jax``
+module so the same call sites run unmodified. On a new jax every installer
+is a no-op.
+
+Import this module (``from ray_tpu.parallel import _compat  # noqa``)
+before calling ``jax.shard_map`` / ``jax.make_mesh(axis_types=...)``;
+installation happens at import and is idempotent.
+"""
+
+from __future__ import annotations
+
+
+def install() -> bool:
+    """Install every missing adapter onto the live jax module. Returns
+    False when jax itself is unavailable (callers degrade gracefully)."""
+    try:
+        import jax
+    except Exception:   # pragma: no cover - jax is a hard dep in practice
+        return False
+    _install_axis_type(jax)
+    _install_make_mesh(jax)
+    _install_shard_map(jax)
+    _install_axis_size(jax)
+    return True
+
+
+def _install_axis_type(jax) -> None:
+    if hasattr(jax.sharding, "AxisType"):
+        return
+    import enum
+
+    class AxisType(enum.Enum):
+        """Stand-in for jax.sharding.AxisType (new explicit-sharding
+        API). Old jax has only Auto-style meshes, so the value is
+        accepted and dropped by the make_mesh adapter below."""
+
+        Auto = "auto"
+        Explicit = "explicit"
+        Manual = "manual"
+
+    jax.sharding.AxisType = AxisType
+
+
+def _install_make_mesh(jax) -> None:
+    import inspect
+
+    make_mesh = getattr(jax, "make_mesh", None)
+    if make_mesh is None:
+        return
+    try:
+        params = inspect.signature(make_mesh).parameters
+    except (TypeError, ValueError):   # pragma: no cover - C callables
+        return
+    if "axis_types" in params:
+        return
+
+    def make_mesh_compat(axis_shapes, axis_names, *, axis_types=None,
+                         **kwargs):
+        # old make_mesh predates axis typing: every axis behaves as Auto,
+        # which is exactly what dropping the argument yields
+        return make_mesh(axis_shapes, axis_names, **kwargs)
+
+    make_mesh_compat.__doc__ = make_mesh.__doc__
+    jax.make_mesh = make_mesh_compat
+
+
+def _install_shard_map(jax) -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except Exception:   # pragma: no cover - ancient jax
+        return
+
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None, check_rep=None, **kwargs):
+        check = True
+        if check_rep is not None:
+            check = check_rep
+        if check_vma is not None:
+            check = check_vma
+        auto = kwargs.pop("auto", None)
+        if auto is None and axis_names is not None:
+            # new API names the MANUAL axes; legacy names the AUTO rest
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            return _legacy(f, mesh, in_specs, out_specs,
+                           check_rep=check, auto=frozenset(auto), **kwargs)
+        return _legacy(f, mesh, in_specs, out_specs, check_rep=check,
+                       **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size(jax) -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        if isinstance(axis_name, (tuple, list)):
+            import math
+
+            return math.prod(int(axis_size(a)) for a in axis_name)
+        # 0.4.x axis_frame(name) returns the bound size itself; slightly
+        # newer releases return a frame object carrying .size
+        frame = jax.core.axis_frame(axis_name)
+        return int(getattr(frame, "size", frame))
+
+    jax.lax.axis_size = axis_size
+
+
+install()

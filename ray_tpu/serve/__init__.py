@@ -30,6 +30,8 @@ from ray_tpu.serve.grpc_proxy import (GrpcServeClient, shutdown_grpc,
                                       start_grpc)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import Request, Response
+from ray_tpu.serve.llm_deployment import SimLLMServer, build_llm_app
+from ray_tpu.serve.llm_router import LLMRouter
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
@@ -38,4 +40,5 @@ __all__ = [
     "DeploymentHandle", "Request", "Response", "multiplexed",
     "get_multiplexed_model_id", "build_app", "InputNode", "DAGDriverImpl",
     "start_grpc", "shutdown_grpc", "GrpcServeClient",
+    "LLMRouter", "SimLLMServer", "build_llm_app",
 ]

@@ -117,7 +117,29 @@ class Replica:
             self.inflight -= 1
 
     def queue_len(self) -> int:
-        return self.inflight
+        """RPC in-flight count, plus the instance's own backlog when it
+        exposes one (LLMServer.queue_len: engine pending + active slots).
+        A streaming LLM replica parks few RPCs but can hold many
+        generations — autoscaling and drain must see those too."""
+        n = self.inflight
+        ql = getattr(self.instance, "queue_len", None)
+        if callable(ql):
+            try:
+                n += int(ql())
+            except Exception:
+                pass
+        return n
+
+    def drain(self) -> bool:
+        """Tell the instance to stop accepting new work (scale-down
+        protocol); returns immediately, in-flight work keeps running."""
+        fn = getattr(self.instance, "drain", None)
+        if callable(fn):
+            try:
+                fn()
+            except Exception:
+                pass
+        return True
 
     def reconfigure(self, user_config):
         if hasattr(self.instance, "reconfigure"):
@@ -160,6 +182,10 @@ class ServeController:
         self._qhist: Dict[str, List[tuple]] = {}
         # pending scale decision: name -> (direction, first_seen_ts, want)
         self._pending_scale: Dict[str, tuple] = {}
+        # router-reported load: name -> {reporter: (ts, load)}. LLM
+        # routers push their local queue depth here so autoscaling sees
+        # demand that was SHED before reaching any replica's queue.
+        self._ext_load: Dict[str, Dict[str, tuple]] = {}
         self._restore()
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
         self._thread.start()
@@ -295,6 +321,25 @@ class ServeController:
                          "config": d["config"], "version": d["version"]}
         return out
 
+    def report_load(self, name: str, reporter: str, load: float) -> bool:
+        """Routers push their OWN queue depth (requests admitted by the
+        router but not yet placed on a replica). Folded into the
+        autoscale total each control tick; stale reporters (a dead
+        router) age out after 10 s so they cannot pin the fleet up."""
+        with self._lock:
+            self._ext_load.setdefault(name, {})[reporter] = (
+                time.time(), float(load))
+        return True
+
+    def _ext_load_total(self, name: str) -> float:
+        now = time.time()
+        with self._lock:
+            per = self._ext_load.get(name, {})
+            stale = [k for k, (ts, _) in per.items() if now - ts > 10.0]
+            for k in stale:
+                del per[k]
+            return sum(load for _, load in per.values())
+
     def ping(self) -> str:
         return "pong"
 
@@ -397,19 +442,57 @@ class ServeController:
                 continue
             replicas.append(h)
             names.append(rn)
+        # Scale-down drains instead of killing: unpublish FIRST (the
+        # table update + bump below pushes the shrunk set to every
+        # router long-poll, so no new requests target the retiring
+        # replicas), then a background thread waits for their in-flight
+        # work — mid-stream generations included — before the kill.
+        retiring = []
         while len(replicas) > target:
-            r = replicas.pop()
+            retiring.append(replicas.pop())
             names.pop()
-            try:
-                ray_tpu.kill(r)
-            except Exception:
-                pass
         with self._lock:
             if name in self.deployments:
                 self.deployments[name]["replicas"] = replicas
                 self.deployments[name]["replica_names"] = names
         self._save()
         self._bump(f"replicas:{name}")
+        if retiring:
+            threading.Thread(target=self._drain_then_kill,
+                             args=(retiring,), daemon=True).start()
+
+    def _drain_then_kill(self, retiring: List[Any]):
+        """Scale-down grace: tell each retiring replica to stop
+        admitting (Replica.drain -> instance drain), poll queue_len to 0
+        under serve_drain_timeout_s, then kill. A replica that cannot
+        drain in time is killed anyway — the bound keeps scale-down from
+        hanging behind a wedged stream."""
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        deadline = time.time() + GLOBAL_CONFIG.serve_drain_timeout_s
+        for r in retiring:
+            try:
+                ray_tpu.get(r.drain.remote(), timeout=5)
+            except Exception:
+                pass   # dead/unreachable: the kill below still runs
+        pending = list(retiring)
+        while pending and time.time() < deadline \
+                and not self._stop.is_set():
+            still = []
+            for r in pending:
+                try:
+                    if ray_tpu.get(r.queue_len.remote(), timeout=5) > 0:
+                        still.append(r)
+                except Exception:
+                    pass   # already dead: drained by definition
+            pending = still
+            if pending:
+                self._stop.wait(0.2)
+        for r in retiring:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
 
     # ---- autoscaling (ref: autoscaling_policy.py) --------------------------
 
@@ -475,7 +558,8 @@ class ServeController:
                                       for r in d["replicas"]], timeout=5)
                 except Exception:
                     continue
-                want = self._autoscale_decision(name, d, sum(qs))
+                total = sum(qs) + self._ext_load_total(name)
+                want = self._autoscale_decision(name, d, total)
                 if want is not None and want != len(d["replicas"]):
                     with self._lock:
                         d["config"]["num_replicas"] = want

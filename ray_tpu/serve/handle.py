@@ -21,15 +21,36 @@ import ray_tpu
 
 
 class Router:
+    """In-flight counts are keyed by replica ACTOR ID, not list index:
+    the pushed replacement set reorders/reuses indices, so an index-keyed
+    count stranded by a replica death (its done() never ran) would
+    permanently bias the power-of-two picker away from whichever healthy
+    replica later occupies that slot. Keyed by identity, a dead replica's
+    count dies with it (evict pops the key) and survivors keep their real
+    counts across set pushes."""
+
     def __init__(self, deployment_name: str, controller_name: str = "_serve_controller"):
         self.deployment_name = deployment_name
         self.controller_name = controller_name
         self._replicas: List[Any] = []
-        self._inflight: Dict[int, int] = {}
+        self._inflight: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         self._gen = 0
         self._poller_started = False
+
+    @staticmethod
+    def _key(replica) -> str:
+        return replica._actor_id.hex()
+
+    def _set_replicas(self, replicas: List[Any]):
+        """Adopt a new replica set (caller holds self._lock): keep live
+        counts for replicas still in the set, drop counts of the gone
+        (decrement-on-evict — their in-flight work died with them)."""
+        keep = {self._key(r) for r in replicas}
+        self._replicas = replicas
+        self._inflight = {k: v for k, v in self._inflight.items()
+                          if k in keep}
 
     def _ensure_poller(self):
         if self._poller_started:
@@ -53,10 +74,7 @@ class Router:
                 self._gen = res["gen"]
                 if changed and res["value"] is not None:
                     with self._lock:
-                        self._replicas = res["value"]
-                        self._inflight = {
-                            i: self._inflight.get(i, 0)
-                            for i in range(len(res["value"]))}
+                        self._set_replicas(res["value"])
                         self._last_refresh = time.time()
             except Exception:
                 # controller down/restarting: back off, then re-resolve
@@ -71,9 +89,7 @@ class Router:
         replicas = ray_tpu.get(
             controller.get_replicas.remote(self.deployment_name))
         with self._lock:
-            self._replicas = replicas
-            self._inflight = {i: self._inflight.get(i, 0)
-                              for i in range(len(replicas))}
+            self._set_replicas(replicas)
             self._last_refresh = now
 
     def pick(self) -> tuple:
@@ -88,28 +104,32 @@ class Router:
                 i = 0
             else:
                 a, b = random.sample(range(n), 2)
-                i = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
-            self._inflight[i] = self._inflight.get(i, 0) + 1
-            return i, self._replicas[i]
+                ka = self._key(self._replicas[a])
+                kb = self._key(self._replicas[b])
+                i = (a if self._inflight.get(ka, 0)
+                     <= self._inflight.get(kb, 0) else b)
+            key = self._key(self._replicas[i])
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+            return key, self._replicas[i]
 
-    def done(self, idx: int):
+    def done(self, key: str):
         with self._lock:
-            if idx in self._inflight and self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            if self._inflight.get(key, 0) > 0:
+                self._inflight[key] -= 1
 
     def evict(self, actor_hex: Optional[str]):
         """Drop a dead replica from the local set IMMEDIATELY (ref:
         router.py on-ActorDiedError eviction): a retry must not wait for
         the controller's next health probe to stop targeting it. The
-        pushed replacement set supersedes this on arrival."""
+        pushed replacement set supersedes this on arrival; survivors keep
+        their in-flight counts, the dead replica's count is discarded."""
         if not actor_hex:
             return
         with self._lock:
             keep = [r for r in self._replicas
                     if r._actor_id.hex() != actor_hex]
             if len(keep) != len(self._replicas):
-                self._replicas = keep
-                self._inflight = {i: 0 for i in range(len(keep))}
+                self._set_replicas(keep)
 
 
 class DeploymentHandle:
@@ -156,15 +176,15 @@ class DeploymentHandle:
         entry = ("handle_request_streaming" if getattr(self, "_stream", False)
                  else "handle_request")
         for attempt in range(3):
-            idx, replica = router.pick()
+            key, replica = router.pick()
             try:
                 ref = getattr(replica, entry).remote(
                     method, args, kwargs, self._context or None)
-                router.done(idx)
+                router.done(key)
                 return ref
             except (ray_tpu.exceptions.ActorDiedError,
                     ray_tpu.exceptions.ActorUnavailableError) as e:
-                router.done(idx)
+                router.done(key)
                 router.evict(getattr(e, "actor_id", None))
                 if not router._replicas:
                     router._refresh(force=True)

@@ -254,11 +254,30 @@ class HTTPProxy:
             # Streaming contract: the deployment defines `stream_request`
             # (sync/async generator); items flush to the client as HTTP
             # chunks in yield order (ref: serve response streaming over
-            # obj-ref generators).
+            # obj-ref generators). Clients accepting text/event-stream
+            # get SSE framing (data: <json>\n\n per item).
+            sse = "text/event-stream" in h.headers.get("Accept", "")
             gen = handle.options(stream=True).method(
                 "stream_request").remote(req)
-            return ("stream", "text/plain; charset=utf-8",
-                    self._iter_chunks(gen))
+            # Pull the FIRST item here, before any status line commits:
+            # a shed stream's typed first frame ({"status": 429, ...},
+            # the LLMQueueFull contract) becomes a real 429 +
+            # Retry-After instead of a 200 stream the client must parse.
+            it = iter(gen)
+            first = None
+            try:
+                ref = next(it, None)
+                first = ray_tpu.get(ref) if ref is not None else None
+            except StopIteration:
+                pass
+            if isinstance(first, dict) and first.get("status") == 429:
+                retry = first.get("retry_after_s", 1.0)
+                return (429, "application/json",
+                        json.dumps(first).encode(),
+                        {"Retry-After": f"{retry:g}"})
+            ctype = ("text/event-stream" if sse
+                     else "text/plain; charset=utf-8")
+            return ("stream", ctype, self._iter_chunks(it, first, sse))
         # Retry-on-dead-replica (ref: router.py assign-and-retry): a
         # request that raced a replica death re-routes through the handle
         # (whose router gets the replacement set pushed) instead of
@@ -294,15 +313,24 @@ class HTTPProxy:
         raise last_err
 
     @staticmethod
-    def _iter_chunks(gen):
-        for ref in gen:
-            item = ray_tpu.get(ref)
+    def _iter_chunks(gen, first=None, sse=False):
+        def encode(item):
             if isinstance(item, (bytes, bytearray)):
-                yield bytes(item)
+                data = bytes(item)
             elif isinstance(item, str):
-                yield item.encode()
+                data = item.encode()
             else:
-                yield (json.dumps(item) + "\n").encode()
+                data = json.dumps(item).encode()
+            if sse:
+                return b"data: " + data + b"\n\n"
+            if not isinstance(item, (bytes, bytearray, str)):
+                data += b"\n"
+            return data
+
+        if first is not None:
+            yield encode(first)
+        for ref in gen:
+            yield encode(ray_tpu.get(ref))
 
     def ready(self) -> int:
         return self.port

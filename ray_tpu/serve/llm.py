@@ -952,6 +952,7 @@ class LLMServer:
         self.decode_block = decode_block
         self._wake = threading.Event()
         self._stop = False
+        self._draining = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -970,6 +971,8 @@ class LLMServer:
             request = request.json()
         prompt = list(request["prompt"])
         try:
+            if self._draining:
+                raise LLMQueueFull("replica draining; retry elsewhere")
             req = self.engine.submit(prompt,
                                      int(request.get("max_new_tokens", 32)),
                                      float(request.get("temperature", 0.0)))
@@ -997,6 +1000,8 @@ class LLMServer:
         (handle calls)."""
         body = request if isinstance(request, dict) else request.json()
         try:
+            if self._draining:
+                raise LLMQueueFull("replica draining; retry elsewhere")
             req = self.engine.submit(list(body["prompt"]),
                                      int(body.get("max_new_tokens", 32)),
                                      float(body.get("temperature", 0.0)))
@@ -1033,8 +1038,34 @@ class LLMServer:
             out["error"] = req.error
         yield out
 
+    def queue_len(self) -> int:
+        """Engine-side backlog: requests queued for admission plus slots
+        mid-generation. The serve Replica adds this to its own RPC
+        in-flight count, so the controller's autoscaler and the LLM
+        router's pressure score both see work the engine has ACCEPTED
+        but not finished — not just the RPCs currently parked in
+        stream_request."""
+        eng = self.engine
+        with eng.lock:
+            return (len(eng.pending)
+                    + sum(1 for s in eng.slots if s is not None))
+
+    def drain(self) -> None:
+        """Stop accepting new work; in-flight generations run to
+        completion. New submissions shed with LLMQueueFull, which the
+        LLM router reads as 'route elsewhere' — the scale-down protocol
+        (ServeController._drain_then_kill) then polls queue_len() to 0
+        before killing the actor."""
+        self._draining = True
+
     def stats(self) -> Dict[str, Any]:
         m = dict(self.engine.metrics)
+        with self.engine.lock:
+            m["pending"] = len(self.engine.pending)
+            m["active_slots"] = sum(
+                1 for s in self.engine.slots if s is not None)
+            m["max_slots"] = self.engine.max_slots
+        m["draining"] = self._draining
         if m["ttft_count"]:
             m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
             p50 = self.engine._m_ttft.quantile(0.5)

@@ -1,0 +1,216 @@
+"""LLM serving application builder + deterministic sim replica.
+
+build_llm_app composes the two-tier serving graph the router needs
+(ref: serve deployment-graph composition, api.py bind/_handleize):
+
+    LLMRouter (ingress, 1 replica)  ->  LLMServer x N (paged KV engines)
+
+serve.run deploys children first, so the router's injected
+DeploymentHandle resolves live replicas immediately.
+
+SimLLMServer is a deterministic LLMServer stand-in for router tests and
+the serve_router bench: it honors the same streaming contract
+(stream_request frames, LLMQueueFull-as-429 first frame), the same
+stats() fields the router's pressure score reads, and a prefix cache
+with the same register/match semantics — but its "generation" is
+asyncio.sleep-based, so routing properties (affinity hit rate, shed
+behavior, failover token continuity, replica scaling) are measured as
+real wall-clock effects without a jax engine. Token i of a submission
+whose prompt has L tokens is L + i: after a mid-stream failover
+resubmits prompt+generated, the continuation is exactly the next
+integer — token continuity asserts are exact, not statistical.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve import api as serve_api
+from ray_tpu.serve.llm_router import LLMRouter
+
+_PAGE = 16   # sim prefix-cache granularity (tokens per "page")
+
+
+class SimLLMServer:
+    """Deterministic fake engine with real queueing/caching dynamics."""
+
+    def __init__(self, *, max_slots: int = 8,
+                 max_queue_depth: Optional[int] = 64,
+                 prefill_s_per_token: float = 0.0002,
+                 decode_s_per_token: float = 0.002,
+                 tokens_per_frame: int = 4,
+                 prefix_caching: bool = True,
+                 prefix_cache_pages: int = 64):
+        self.max_slots = max_slots
+        self.max_queue_depth = max_queue_depth
+        self.prefill_s_per_token = prefill_s_per_token
+        self.decode_s_per_token = decode_s_per_token
+        self.tokens_per_frame = max(int(tokens_per_frame), 1)
+        self.prefix_caching = prefix_caching
+        self.prefix_cache_pages = prefix_cache_pages
+        # LRU by insertion/touch order, like PagePool's reclaim of
+        # refcount-0 cached pages: a replica whose routed working set
+        # exceeds capacity THRASHES — the effect prefix affinity exists
+        # to avoid (it partitions prefix groups across replicas so each
+        # replica's set fits).
+        from collections import OrderedDict
+
+        self._cached_pages: "OrderedDict[tuple, None]" = OrderedDict()
+        self._slots = asyncio.Semaphore(max_slots)
+        self._pending = 0
+        self._active = 0
+        self._draining = False
+        self._lock = threading.Lock()
+        self.metrics: Dict[str, Any] = {
+            "requests": 0, "tokens_generated": 0, "rejected": 0,
+            "prefix_hits": 0, "prefix_hit_tokens": 0,
+            "admit_s": 0.0, "decode_block_s": 0.0,
+            "ttft_sum": 0.0, "ttft_count": 0}
+
+    # -- prefix cache sim: leading full pages by content hash ---------------
+
+    def _page_hashes(self, prompt: List[int]) -> List[tuple]:
+        out, acc = [], []
+        for i in range(0, len(prompt) - len(prompt) % _PAGE, _PAGE):
+            acc.extend(prompt[i:i + _PAGE])
+            out.append(tuple(acc))
+        return out
+
+    def _match_and_register(self, prompt: List[int]) -> int:
+        if not self.prefix_caching:
+            return 0
+        hashes = self._page_hashes(prompt)
+        matched = 0
+        for h in hashes:
+            if h in self._cached_pages:
+                matched += _PAGE
+            else:
+                break
+        for h in hashes:   # touch + register (LRU order)
+            self._cached_pages[h] = None
+            self._cached_pages.move_to_end(h)
+        while len(self._cached_pages) > self.prefix_cache_pages:
+            self._cached_pages.popitem(last=False)
+        if matched:
+            self.metrics["prefix_hits"] += 1
+            self.metrics["prefix_hit_tokens"] += matched
+        return matched
+
+    # -- serving contract ----------------------------------------------------
+
+    async def stream_request(self, request) -> Any:
+        body = request if isinstance(request, dict) else request.json()
+        prompt = list(body["prompt"])
+        max_new = int(body.get("max_new_tokens", 32))
+        with self._lock:
+            backlog = self._pending + self._active
+            if self._draining or (self.max_queue_depth is not None
+                                  and backlog >= self.max_queue_depth):
+                self.metrics["rejected"] += 1
+                shed = True
+            else:
+                self.metrics["requests"] += 1
+                self._pending += 1
+                shed = False
+        if shed:
+            yield {"error": "sim queue full" if not self._draining
+                   else "replica draining", "status": 429, "done": True}
+            return
+        t_sub = time.time()
+        async with self._slots:
+            with self._lock:
+                self._pending -= 1
+                self._active += 1
+                matched = self._match_and_register(prompt)
+            try:
+                t0 = time.time()
+                # prefill cost scales with the UNCACHED prompt tail —
+                # this is the wall-clock effect prefix affinity buys
+                await asyncio.sleep(
+                    self.prefill_s_per_token * (len(prompt) - matched))
+                dt = time.time() - t0
+                with self._lock:
+                    self.metrics["admit_s"] += dt
+                L = len(prompt)
+                ttft = None
+                i = 0
+                while i < max_new:
+                    n = min(self.tokens_per_frame, max_new - i)
+                    t1 = time.time()
+                    await asyncio.sleep(self.decode_s_per_token * n)
+                    with self._lock:
+                        self.metrics["decode_block_s"] += time.time() - t1
+                        self.metrics["tokens_generated"] += n
+                    if ttft is None:
+                        ttft = time.time() - t_sub
+                        with self._lock:
+                            self.metrics["ttft_sum"] += ttft
+                            self.metrics["ttft_count"] += 1
+                    yield {"tokens": [L + j for j in range(i, i + n)]}
+                    i += n
+                yield {"done": True, "n_tokens": max_new, "ttft_s": ttft}
+            finally:
+                with self._lock:
+                    self._active -= 1
+
+    async def __call__(self, request) -> Dict[str, Any]:
+        tokens: List[int] = []
+        final: Dict[str, Any] = {}
+        async for frame in self.stream_request(request):
+            if frame.get("status") == 429:
+                from ray_tpu.serve.http_proxy import Response
+
+                return Response({"error": frame.get("error")},
+                                status_code=429,
+                                headers={"Retry-After": "1"})
+            if frame.get("done"):
+                final = frame
+            tokens.extend(frame.get("tokens", []))
+        return {"tokens": tokens, "ttft_s": final.get("ttft_s")}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            m = dict(self.metrics)
+            m["pending"] = self._pending
+            m["active_slots"] = self._active
+            m["max_slots"] = self.max_slots
+            m["draining"] = self._draining
+        if m["ttft_count"]:
+            m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
+        return m
+
+    def queue_len(self) -> int:
+        with self._lock:
+            return self._pending + self._active
+
+    def drain(self) -> None:
+        self._draining = True
+
+
+def build_llm_app(*, name: str = "llm_server",
+                  num_replicas: int = 2,
+                  router_policy: str = "affinity",
+                  autoscaling_config: Optional[dict] = None,
+                  use_sim: bool = False,
+                  router_kwargs: Optional[dict] = None,
+                  **llm_kwargs) -> Any:
+    """Build the router-fronted serving application. llm_kwargs go to
+    LLMServer (preset, max_slots, kv_layout, ...) — or to SimLLMServer
+    when use_sim=True (tests/bench). Returns the Application; deploy
+    with serve.run(app, route_prefix=...)."""
+    if use_sim:
+        server_cls = SimLLMServer
+    else:
+        from ray_tpu.serve.llm import LLMServer
+
+        server_cls = LLMServer
+    llm = serve_api.deployment(
+        server_cls, name=name, num_replicas=num_replicas,
+        autoscaling_config=autoscaling_config).bind(**llm_kwargs)
+    router = serve_api.deployment(
+        LLMRouter, name=f"{name}_router", num_replicas=1).bind(
+        llm, policy=router_policy, **(router_kwargs or {}))
+    return router

@@ -1,0 +1,448 @@
+"""LLMRouter: prefix-cache-aware routing across LLMServer replicas.
+
+Multi-replica LLM serving needs a router that is smarter than the
+generic power-of-two handle: paged-KV prefix caching (serve/paged_kv.py
+PagePool + llm.py automatic prefix caching) makes replica choice
+STATEFUL — a request whose prompt shares a prefix with earlier traffic
+is dramatically cheaper on the replica that already holds those KV
+pages (TTFT skips the prefix's prefill compute AND its page memory).
+Ref: vLLM's prefix-aware routing in production routers (e.g. the
+llm-d / vllm-router session-affinity schemes); the reference serve
+stack has no LLM-aware routing at all.
+
+Routing policy, per request:
+
+1. PREFIX AFFINITY — hash the first ``llm_router_prefix_tokens`` prompt
+   tokens and rank replicas by rendezvous (highest-random-weight)
+   hashing of (prefix_hash x replica actor id). All streams sharing a
+   prefix agree on the same ranking without any shared state, and a
+   replica joining/leaving only remaps the streams that hashed to it —
+   no global reshuffle (the property consistent hashing buys).
+2. OVERLOAD FALLBACK — affinity yields to load: if the preferred
+   replica's pressure exceeds ``llm_router_overload_factor`` x the
+   fleet mean, walk down the rendezvous ranking; if every replica is
+   hot, take the least-pressured (pure load balancing).
+   pressure = (router in-flight + engine pending) * (1 + busy), where
+   busy is an EWMA of the replica's admit_s + decode_block_s rate from
+   LLMServer.stats() — a replica spending all its wall time in
+   admission/decode is saturated even at equal queue depth.
+3. ADMISSION — a router-wide in-flight bound (``llm_router_max_inflight``)
+   sheds excess demand with a typed 429 + Retry-After first frame
+   instead of queueing unboundedly (same contract as LLMQueueFull at
+   the engine).
+
+Streaming failover: the router owns each replica stream and re-routes a
+mid-stream replica death by resubmitting prompt + tokens-generated-so-far
+(max_new_tokens decremented by the emitted count) to a surviving
+replica. The client-visible stream continues with no duplicated or
+dropped tokens — the resubmission's prompt IS the already-emitted
+sequence, so the new replica only ever generates the continuation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.serve.handle import DeploymentHandle, Router
+from ray_tpu.util import metrics as _um
+from ray_tpu.util.tracing import span
+
+_END = object()
+
+
+def _next_item(gen):
+    """One blocking stream step (runs on an executor thread: raylint
+    blocking-in-async). Raises the replica's ActorDiedError here when it
+    died mid-stream — the async caller re-routes."""
+    try:
+        ref = next(gen)
+    except StopIteration:
+        return _END
+    return ray_tpu.get(ref)
+
+
+def prefix_hash(tokens: List[int], n: int) -> str:
+    """Stable cross-process hash of the first n prompt tokens."""
+    head = ",".join(str(int(t)) for t in tokens[:n])
+    return hashlib.sha1(head.encode()).hexdigest()
+
+
+class LLMRouter:
+    """Ingress deployment fronting an LLMServer deployment.
+
+    Compose with serve.deployment + bind (see llm_deployment.build_llm_app):
+    the LLMServer application is passed to bind() and arrives here as a
+    DeploymentHandle; the router reads its replica set (long-poll pushed)
+    through the handle's underlying Router but makes its OWN placement
+    decisions.
+    """
+
+    def __init__(self, llm_handle: DeploymentHandle, *,
+                 policy: str = "affinity",
+                 prefix_tokens: Optional[int] = None,
+                 max_inflight: Optional[int] = None,
+                 overload_factor: Optional[float] = None,
+                 stats_interval_s: Optional[float] = None,
+                 report_load: bool = True,
+                 max_attempts: int = 6):
+        if policy not in ("affinity", "p2c", "random"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self._handle = llm_handle
+        self.policy = policy
+        cfg = GLOBAL_CONFIG
+        self.prefix_tokens = (prefix_tokens if prefix_tokens is not None
+                              else cfg.llm_router_prefix_tokens)
+        self.max_inflight = (max_inflight if max_inflight is not None
+                             else cfg.llm_router_max_inflight)
+        self.overload_factor = (overload_factor if overload_factor is not None
+                                else cfg.llm_router_overload_factor)
+        self._stats_interval = (stats_interval_s if stats_interval_s
+                                is not None
+                                else cfg.llm_router_stats_interval_s)
+        self._report_load = report_load
+        self.max_attempts = max_attempts
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, int] = {}   # per-replica, router-local
+        self._total_inflight = 0
+        #: per-replica view from the stats poll thread:
+        #: {pending, active, draining, busy, _raw_busy_s, _ts}
+        self._replica_stats: Dict[str, Dict[str, Any]] = {}
+        self.counters = {"requests": 0, "shed": 0, "replica_shed": 0,
+                         "reroutes": 0, "affinity_picks": 0,
+                         "fallback_picks": 0}
+        try:
+            me = (ray_tpu.get_runtime_context().get_actor_id() or "driver")
+        except Exception:
+            me = "local"
+        self._reporter = f"llm_router_{str(me)[:12]}"
+        tag = {"router": self._reporter[-12:]}
+        self._m_requests = _um.Counter(
+            "ray_tpu_llm_router_requests", "requests routed",
+            tag_keys=("router",)).set_default_tags(tag)
+        self._m_sheds = _um.Counter(
+            "ray_tpu_llm_router_sheds",
+            "requests shed at the router admission bound",
+            tag_keys=("router",)).set_default_tags(tag)
+        self._m_reroutes = _um.Counter(
+            "ray_tpu_llm_router_reroutes",
+            "mid-stream failovers to a surviving replica",
+            tag_keys=("router",)).set_default_tags(tag)
+        self._m_affinity = _um.Counter(
+            "ray_tpu_llm_router_affinity_picks",
+            "placements on the rendezvous-preferred replica",
+            tag_keys=("router",)).set_default_tags(tag)
+        self._m_inflight = _um.Gauge(
+            "ray_tpu_llm_router_inflight", "streams in flight",
+            tag_keys=("router",)).set_default_tags(tag)
+        self._m_ttft = _um.Histogram(
+            "ray_tpu_llm_router_ttft_s",
+            "router-observed time to first token",
+            boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30],
+            tag_keys=("router",)).set_default_tags(tag)
+        # Dedicated executor for blocking stream pulls: every in-flight
+        # stream PARKS a thread in _next_item waiting for the replica's
+        # next frame, so the event loop's small default pool would cap
+        # concurrency at ~cpu+4 streams and stall the rest.
+        import concurrent.futures
+
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(self.max_inflight + 4, 512),
+            thread_name_prefix="llm_router")
+        self._stop = threading.Event()
+        self._stats_thread = threading.Thread(target=self._stats_loop,
+                                              daemon=True)
+        self._stats_thread.start()
+
+    # ---- replica view ------------------------------------------------------
+
+    def _snapshot(self, force: bool = False) -> List[Tuple[str, Any]]:
+        rt = self._handle._get_router()
+        rt._ensure_poller()
+        rt._refresh(force)
+        with rt._lock:
+            reps = list(rt._replicas)
+        return [(Router._key(r), r) for r in reps]
+
+    def _pressure(self, key: str) -> float:
+        st = self._replica_stats.get(key, {})
+        load = self._inflight.get(key, 0) + st.get("pending", 0)
+        return load * (1.0 + st.get("busy", 0.0))
+
+    def _stats_loop(self):
+        """Poll LLMServer.stats() per replica on a fixed cadence; derive
+        the busy-fraction EWMA feeding the pressure score, and push the
+        router's own queue depth to the controller so autoscaling sees
+        demand the replicas haven't accepted yet."""
+        alpha = 0.5
+        while not self._stop.wait(self._stats_interval):
+            try:
+                reps = self._snapshot()
+            except Exception:
+                continue
+            now = time.time()
+            for key, replica in reps:
+                try:
+                    raw = ray_tpu.get(
+                        replica.handle_request.remote("stats", (), {}, None),
+                        timeout=5)
+                except Exception:
+                    continue   # dead replicas age out via the long-poll set
+                busy_s = float(raw.get("admit_s", 0.0)) + \
+                    float(raw.get("decode_block_s", 0.0))
+                with self._lock:
+                    prev = self._replica_stats.get(key)
+                    frac = 0.0
+                    if prev is not None and now > prev["_ts"]:
+                        frac = max(busy_s - prev["_raw_busy_s"], 0.0) \
+                            / (now - prev["_ts"])
+                    ewma = (frac if prev is None
+                            else alpha * frac + (1 - alpha) * prev["busy"])
+                    self._replica_stats[key] = {
+                        "pending": int(raw.get("pending", 0)),
+                        "active": int(raw.get("active_slots", 0)),
+                        "draining": bool(raw.get("draining", False)),
+                        "busy": min(ewma, 4.0),
+                        "_raw_busy_s": busy_s, "_ts": now,
+                    }
+            with self._lock:
+                live = {k for k, _ in reps}
+                for k in list(self._replica_stats):
+                    if k not in live:
+                        del self._replica_stats[k]
+                depth = self._total_inflight
+            if self._report_load:
+                try:
+                    controller = ray_tpu.get_actor("_serve_controller",
+                                                   namespace="serve")
+                    ray_tpu.get(controller.report_load.remote(
+                        self._handle.deployment_name, self._reporter,
+                        depth), timeout=5)
+                except Exception:
+                    pass   # controller restarting: next tick re-reports
+
+    # ---- placement ---------------------------------------------------------
+
+    def _pick(self, prompt: List[int], avoid: set) -> Tuple[str, Any]:
+        """Choose a replica (blocking; call from an executor thread).
+        avoid = replicas that already shed this request."""
+        import random
+
+        reps = self._snapshot()
+        if not reps:
+            reps = self._snapshot(force=True)
+        with self._lock:
+            stats = dict(self._replica_stats)
+        usable = [(k, r) for k, r in reps
+                  if k not in avoid
+                  and not stats.get(k, {}).get("draining", False)]
+        if not usable:
+            # every replica draining/avoided: last resort is the raw set
+            usable = [(k, r) for k, r in reps if k not in avoid]
+        if not usable:
+            raise RuntimeError(
+                f"no usable replicas for {self._handle.deployment_name!r}")
+        with span("llm_router.route", {"policy": self.policy,
+                                       "n_replicas": len(usable)}):
+            if self.policy == "random" or len(usable) == 1:
+                return usable[random.randrange(len(usable))]
+            if self.policy == "p2c":
+                a, b = random.sample(range(len(usable)), 2)
+                ka, kb = usable[a][0], usable[b][0]
+                return usable[a if self._pressure(ka)
+                              <= self._pressure(kb) else b]
+            ph = prefix_hash(prompt, self.prefix_tokens)
+            ranked = sorted(
+                usable, key=lambda kr: hashlib.sha1(
+                    f"{ph}:{kr[0]}".encode()).digest(), reverse=True)
+            mean = sum(self._pressure(k) for k, _ in usable) / len(usable)
+            limit = self.overload_factor * max(mean, 1.0)
+            for rank, (k, r) in enumerate(ranked):
+                if self._pressure(k) <= limit:
+                    with self._lock:
+                        if rank == 0:
+                            self.counters["affinity_picks"] += 1
+                        else:
+                            self.counters["fallback_picks"] += 1
+                    if rank == 0:
+                        self._m_affinity.inc()
+                    return k, r
+            with self._lock:
+                self.counters["fallback_picks"] += 1
+            return min(ranked, key=lambda kr: self._pressure(kr[0]))
+
+    # ---- request paths -----------------------------------------------------
+
+    async def stream_request(self, request) -> Any:
+        """End-to-end streaming entry (HTTP ?stream=1 / SSE, or handle
+        calls): admission -> placement -> fan the replica's token frames
+        through, surviving replica death mid-stream by re-routing with
+        prompt + generated-so-far."""
+        body = request if isinstance(request, dict) else request.json()
+        prompt = list(body["prompt"])
+        max_new = int(body.get("max_new_tokens", 32))
+        temperature = float(body.get("temperature", 0.0))
+        with self._lock:
+            if self._total_inflight >= self.max_inflight:
+                self.counters["shed"] += 1
+                shed = True
+            else:
+                self._total_inflight += 1
+                self.counters["requests"] += 1
+                shed = False
+            self._m_inflight.set(self._total_inflight)
+        if shed:
+            self._m_sheds.inc()
+            yield {"error": f"router at max_inflight={self.max_inflight}; "
+                            "retry later",
+                   "status": 429, "retry_after_s": 1.0, "done": True}
+            return
+        self._m_requests.inc()
+        loop = asyncio.get_running_loop()
+        t0 = time.time()
+        first_t: Optional[float] = None
+        emitted: List[int] = []
+        avoid: set = set()
+        attempts = 0
+        try:
+            while True:
+                attempts += 1
+                if attempts > self.max_attempts:
+                    yield {"error": "no replica could finish the stream",
+                           "status": 503, "done": True,
+                           "n_tokens": len(emitted)}
+                    return
+                try:
+                    key, replica = await loop.run_in_executor(
+                        self._executor, self._pick, prompt, avoid)
+                except RuntimeError as e:
+                    yield {"error": str(e), "status": 503, "done": True,
+                           "n_tokens": len(emitted)}
+                    return
+                sub = {"prompt": prompt + emitted,
+                       "max_new_tokens": max_new - len(emitted),
+                       "temperature": temperature}
+                with self._lock:
+                    self._inflight[key] = self._inflight.get(key, 0) + 1
+                rerouted = False
+                try:
+                    gen = replica.handle_request_streaming.remote(
+                        "stream_request", (sub,), {}, None)
+                    while True:
+                        try:
+                            item = await loop.run_in_executor(
+                                self._executor, _next_item, gen)
+                        except (ray_tpu.exceptions.ActorDiedError,
+                                ray_tpu.exceptions.ActorUnavailableError
+                                ) as e:
+                            self._on_replica_death(key, e)
+                            rerouted = True
+                            break
+                        if item is _END:
+                            # clean end without a done frame (defensive)
+                            yield self._final(emitted, first_t, t0,
+                                              attempts, key)
+                            return
+                        if isinstance(item, dict) and \
+                                item.get("status") == 429:
+                            # replica shed (queue full or draining):
+                            # route around it, do not fail the client
+                            with self._lock:
+                                self.counters["replica_shed"] += 1
+                            avoid.add(key)
+                            rerouted = True
+                            break
+                        if isinstance(item, dict) and item.get("done"):
+                            out = self._final(emitted, first_t, t0,
+                                              attempts, key)
+                            if item.get("error"):
+                                out["error"] = item["error"]
+                            yield out
+                            return
+                        toks = (item or {}).get("tokens", [])
+                        if toks:
+                            if first_t is None:
+                                first_t = time.time()
+                                self._m_ttft.observe(first_t - t0)
+                            emitted.extend(toks)
+                            yield {"tokens": toks}
+                finally:
+                    with self._lock:
+                        if self._inflight.get(key, 0) > 0:
+                            self._inflight[key] -= 1
+                if not rerouted:
+                    return
+        finally:
+            with self._lock:
+                self._total_inflight = max(self._total_inflight - 1, 0)
+                self._m_inflight.set(self._total_inflight)
+
+    def _on_replica_death(self, key: str, err) -> None:
+        """Mid-stream death: evict from the shared replica view so no
+        request (ours included) re-picks the corpse, then account the
+        re-route. The in-flight decrement rides the attempt's finally —
+        the leak the old index-keyed Router had."""
+        rt = self._handle._get_router()
+        rt.evict(getattr(err, "actor_id", None) or key)
+        with self._lock:
+            self._replica_stats.pop(key, None)
+            self.counters["reroutes"] += 1
+        self._m_reroutes.inc()
+
+    def _final(self, emitted, first_t, t0, attempts, key) -> Dict[str, Any]:
+        return {"done": True, "n_tokens": len(emitted),
+                "ttft_s": (first_t - t0) if first_t is not None else None,
+                "reroutes": attempts - 1, "replica": key[:12]}
+
+    async def __call__(self, request) -> Any:
+        """Non-streaming entry: same routing/failover machinery, result
+        collected. 429s map to Response(429, Retry-After) for the proxy."""
+        body = request if isinstance(request, dict) else request.json()
+        tokens: List[int] = []
+        final: Dict[str, Any] = {}
+        async for frame in self.stream_request(body):
+            if frame.get("status") == 429:
+                from ray_tpu.serve.http_proxy import Response
+
+                retry = frame.get("retry_after_s", 1.0)
+                return Response({"error": frame.get("error")},
+                                status_code=429,
+                                headers={"Retry-After": f"{retry:g}"})
+            if frame.get("done"):
+                final = frame
+            tokens.extend(frame.get("tokens", []))
+        if final.get("error"):
+            from ray_tpu.serve.http_proxy import Response
+
+            return Response({"error": final["error"]},
+                            status_code=int(final.get("status", 500)))
+        return {"tokens": tokens, "ttft_s": final.get("ttft_s"),
+                "reroutes": final.get("reroutes", 0)}
+
+    # ---- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {**dict(self.counters),
+                    "policy": self.policy,
+                    "total_inflight": self._total_inflight,
+                    "inflight": dict(self._inflight),
+                    "replica_stats": {
+                        k: {kk: vv for kk, vv in v.items()
+                            if not kk.startswith("_")}
+                        for k, v in self._replica_stats.items()}}
+
+    def queue_len(self) -> int:
+        with self._lock:
+            return self._total_inflight
+
+    def drain(self) -> None:
+        """Router replica retiring: stop the stats thread; in-flight
+        streams keep running (the controller waits on queue_len)."""
+        self._stop.set()
+        self._executor.shutdown(wait=False)
