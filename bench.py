@@ -427,12 +427,15 @@ def run_serve_router_bench(concurrencies=(64, 256), replica_counts=(1, 2, 4),
     # never registers junk pages that would evict the shared prefixes
     GROUPS, PREFIX_TOK, TAIL_TOK, MAX_NEW = 32, 48, 15, 8
 
-    def run_cell(concurrency, replicas, policy):
+    def run_cell(concurrency, replicas, policy, compiled_hop=None,
+                 warm=False):
+        rkw = {"max_inflight": 100_000, "stats_interval_s": 0.25,
+               "prefix_tokens": PREFIX_TOK}
+        if compiled_hop is not None:
+            rkw["compiled_hop"] = compiled_hop
         app = build_llm_app(
             use_sim=True, num_replicas=replicas, router_policy=policy,
-            router_kwargs={"max_inflight": 100_000,
-                           "stats_interval_s": 0.25,
-                           "prefix_tokens": PREFIX_TOK},
+            router_kwargs=rkw,
             max_slots=4, max_queue_depth=None,
             prefill_s_per_token=0.001, decode_s_per_token=0.004,
             tokens_per_frame=4, prefix_cache_pages=64)
@@ -470,6 +473,17 @@ def run_serve_router_bench(concurrencies=(64, 256), replica_counts=(1, 2, 4),
 
         # warm the routing tables/handles before timing
         ray_tpu.get(handle.method("stats").remote())
+        if warm:
+            # touch every replica's stream path once so one-time costs
+            # (standing-channel negotiation for the compiled hop,
+            # engine spin-up) don't ride the timed TTFT
+            for g in range(0, GROUPS, 4):
+                gen = handle.options(stream=True).method(
+                    "stream_request").remote(
+                        {"prompt": [g] * PREFIX_TOK + [99_000 + g],
+                         "max_new_tokens": 1})
+                for ref in gen:
+                    ray_tpu.get(ref)
         threads = [threading.Thread(target=worker)
                    for _ in range(concurrency)]
         t0 = time.time()
@@ -505,6 +519,8 @@ def run_serve_router_bench(concurrencies=(64, 256), replica_counts=(1, 2, 4),
             "prefix_hit_rate": round(hit_tokens / max(shareable, 1), 4),
             "affinity_picks": rstats.get("affinity_picks", 0),
             "reroutes": rstats.get("reroutes", 0),
+            "compiled_streams": rstats.get("compiled_streams", 0),
+            "legacy_streams": rstats.get("legacy_streams", 0),
         }
 
     ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
@@ -515,6 +531,18 @@ def run_serve_router_bench(concurrencies=(64, 256), replica_counts=(1, 2, 4),
                 cell = run_cell(concurrency, replicas, policy)
                 sweep.append(cell)
                 print(json.dumps(cell))
+    # compiled router->replica hop on vs off at one fixed cell: the
+    # stream-frame path over a standing channel vs the legacy per-frame
+    # handle_request_streaming dispatch. Measured UNSATURATED (clients
+    # fit in the replicas' slots) so TTFT reflects per-frame hop cost,
+    # not queue wait — at saturation the delta drowns in queueing.
+    hop_cells = []
+    for hop in (True, False):
+        cell = run_cell(min(min(concurrencies), 8), 2, "affinity",
+                        compiled_hop=hop, warm=True)
+        cell["compiled_hop"] = hop
+        hop_cells.append(cell)
+        print(json.dumps(cell))
     ray_tpu.shutdown()
 
     def find(c, r, p):
@@ -534,6 +562,13 @@ def run_serve_router_bench(concurrencies=(64, 256), replica_counts=(1, 2, 4),
         if one and two:
             scaling[pol] = round(two["tok_per_s"]
                                  / max(one["tok_per_s"], 1e-9), 2)
+    hop_on = next((c for c in hop_cells if c.get("compiled_hop")), None)
+    hop_off = next((c for c in hop_cells
+                    if c.get("compiled_hop") is False), None)
+    hop_delta = None
+    if (hop_on and hop_off and hop_on.get("ttft_p50_s")
+            and hop_off.get("ttft_p50_s")):
+        hop_delta = round(hop_off["ttft_p50_s"] - hop_on["ttft_p50_s"], 4)
     result = {
         "metric": "serve_router_ttft_p99_affinity_speedup_vs_random",
         "value": headline or 0.0,
@@ -541,11 +576,149 @@ def run_serve_router_bench(concurrencies=(64, 256), replica_counts=(1, 2, 4),
         "vs_baseline": None,
         "extra": {"sweep": sweep,
                   "tok_per_s_scaling_1_to_2_replicas": scaling,
+                  "compiled_hop_ttft": {
+                      "cells": hop_cells,
+                      "ttft_p50_delta_s_legacy_minus_compiled": hop_delta},
                   "note": "prefix-affinity vs random routing over "
                           "SimLLMServer replicas; hit rate = prefix "
                           "tokens served from cache / shareable prefix "
                           "tokens; TTFT measured client-side under "
                           "saturation (queue wait included)"},
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
+
+
+def run_dag_bench(chain_len: int = 4, iters: int = 150,
+                  data_blocks: int = 50, data_rows_per_block: int = 512,
+                  out_path: str = "BENCH_dag.json"):
+    """Per-hop dispatch cost: `.remote()` ref-chaining vs lazy DAG
+    execute vs compiled execution graphs. A chain of `chain_len` Echo
+    actors forwards a scalar `iters` times; wall time / (iters *
+    chain_len) is each variant's per-hop cost. The compiled rows ride
+    standing channels negotiated once at experimental_compile() — each
+    execute() is a raw frame enqueue with no scheduler, no lease
+    round-trip, and no per-call graph walk. Also runs one fixed 2-op
+    map chain under the streaming executor vs the compiled data policy
+    for a rows/s delta (compile setup included). Headline = compiled
+    pipelined us/hop; vs_baseline = remote serial / compiled pipelined
+    (acceptance: >= 10x). Single-core runnable via
+    `python bench.py --bench dag`."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.dag import InputNode, bind_actor
+
+    ray_tpu.init(num_cpus=16, ignore_reinit_error=True)
+
+    @ray_tpu.remote
+    class Echo:
+        def fwd(self, x):
+            return x
+
+    acts = [Echo.remote() for _ in range(chain_len)]
+    ray_tpu.get([a.fwd.remote(1) for a in acts], timeout=60)  # warm pool
+
+    def per_hop(dt):
+        return round(dt / (iters * chain_len) * 1e6, 1)
+
+    # .remote() ref-chaining, one execution in flight — the dispatch
+    # path a compiled graph replaces
+    t0 = time.perf_counter()
+    for i in range(iters):
+        r = i
+        for a in acts:
+            r = a.fwd.remote(r)
+        assert ray_tpu.get(r, timeout=60) == i
+    remote_serial = per_hop(time.perf_counter() - t0)
+
+    # .remote() ref-chaining, all iterations in flight
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(iters):
+        r = i
+        for a in acts:
+            r = a.fwd.remote(r)
+        outs.append(r)
+    assert ray_tpu.get(outs, timeout=120) == list(range(iters))
+    remote_pipe = per_hop(time.perf_counter() - t0)
+
+    with InputNode() as inp:
+        d = inp
+        for a in acts:
+            d = bind_actor(a).fwd.bind(d)
+
+    # lazy DAG: same graph, re-dispatched through .remote() per execute
+    t0 = time.perf_counter()
+    outs = [d.execute(i) for i in range(iters)]
+    assert ray_tpu.get(outs, timeout=120) == list(range(iters))
+    lazy_pipe = per_hop(time.perf_counter() - t0)
+
+    comp = d.experimental_compile()
+    try:
+        comp.execute(0).get(timeout=30)          # warm the channels
+        t0 = time.perf_counter()
+        for i in range(iters):
+            assert comp.execute(i).get(timeout=30) == i
+        comp_serial = per_hop(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        refs = [comp.execute(i) for i in range(iters)]
+        for i, r in enumerate(refs):
+            assert r.get(timeout=60) == i
+        comp_pipe = per_hop(time.perf_counter() - t0)
+    finally:
+        comp.teardown()
+
+    # fixed data chain: identical 2-op map chain through the streaming
+    # executor vs the compiled policy (whole chain fused into one
+    # CompiledChainMapOperator; compile setup counted against it)
+    total_rows = data_blocks * data_rows_per_block
+    data_cell = {"blocks": data_blocks,
+                 "rows_per_block": data_rows_per_block}
+    for policy in ("streaming", "compiled"):
+        try:
+            blocks = [{"x": np.arange(data_rows_per_block,
+                                      dtype=np.float64)
+                       + i * data_rows_per_block}
+                      for i in range(data_blocks)]
+            ds = (rd.Dataset([ray_tpu.put(b) for b in blocks], [])
+                  .map_batches(lambda b: {"x": b["x"] * 1.0001})
+                  .map_batches(lambda b: {"x": b["x"] + 1.0}))
+            t0 = time.perf_counter()
+            n = sum(len(b["x"]) for b in ds._iter_blocks(policy=policy))
+            dt = time.perf_counter() - t0
+            assert n == total_rows, (n, total_rows)
+            data_cell[f"{policy}_rows_per_s"] = round(n / dt)
+        except Exception as e:  # noqa: BLE001 — headline must print
+            data_cell[f"{policy}_error"] = str(e)[:200]
+    ray_tpu.shutdown()
+
+    result = {
+        "metric": "dag_compiled_pipelined_us_per_hop",
+        "value": comp_pipe,
+        "unit": "us/hop",
+        "vs_baseline": round(remote_serial / max(comp_pipe, 1e-9), 1),
+        "extra": {
+            "chain_len": chain_len, "iters": iters,
+            "remote_serial_us_per_hop": remote_serial,
+            "remote_pipelined_us_per_hop": remote_pipe,
+            "lazy_pipelined_us_per_hop": lazy_pipe,
+            "compiled_serial_us_per_hop": comp_serial,
+            "compiled_serial_speedup_vs_remote_serial": round(
+                remote_serial / max(comp_serial, 1e-9), 1),
+            "compiled_pipelined_speedup_vs_remote_pipelined": round(
+                remote_pipe / max(comp_pipe, 1e-9), 1),
+            "data_chain": data_cell,
+            "note": "vs_baseline = remote serial / compiled pipelined "
+                    "us/hop; compiled rows ride standing channels "
+                    "negotiated at compile time, so execute() is a raw "
+                    "frame enqueue; data_chain compares the streaming "
+                    "executor against the compiled policy on the same "
+                    "2-op chain, compile setup included",
+        },
     }
     with open(out_path, "w") as f:
         json.dump(result, f, indent=2)
@@ -749,7 +922,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--bench", default="train",
                     choices=("train", "collective", "data", "telemetry",
-                             "serve_router"),
+                             "serve_router", "dag"),
                     help="train = headline tokens/s/chip (default); "
                          "collective = host-collective backend sweep "
                          "(slow, writes BENCH_collective.json); "
@@ -758,7 +931,9 @@ if __name__ == "__main__":
                          "telemetry = metric/tracing overhead + edge model "
                          "(writes BENCH_telemetry.json); "
                          "serve_router = LLM router concurrency x replicas "
-                         "x policy sweep (writes BENCH_serve_router.json)")
+                         "x policy sweep (writes BENCH_serve_router.json); "
+                         "dag = per-hop .remote() vs lazy vs compiled "
+                         "graph dispatch (writes BENCH_dag.json)")
     ns = ap.parse_args()
     if ns.bench == "collective":
         run_collective_bench()
@@ -768,5 +943,7 @@ if __name__ == "__main__":
         run_telemetry_bench()
     elif ns.bench == "serve_router":
         run_serve_router_bench()
+    elif ns.bench == "dag":
+        run_dag_bench()
     else:
         main()

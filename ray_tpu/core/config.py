@@ -145,6 +145,11 @@ class Config:
     # Background poll period for per-replica LLMServer.stats() feeding
     # the pressure score (busy-fraction EWMA).
     llm_router_stats_interval_s: float = 1.0
+    # Drive the router->replica stream-frame hop through a compiled
+    # two-node graph (dag/compiled.py standing channels) instead of
+    # per-call handle_request_streaming.remote() dispatch; falls back to
+    # the legacy path per replica on compile failure.
+    llm_router_compiled_hop: bool = True
     # Scale-down grace: a draining replica is unpublished from routers
     # immediately, then given this long to finish in-flight streams
     # before the controller kills it.

@@ -15,6 +15,7 @@ Frames:  [u32 len][pickle((kind, msg_id, method, payload))]
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures as _futures
 import itertools
 import pickle
 import struct
@@ -155,6 +156,18 @@ class RpcServer:
                     kind, msg_id, method, payload = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                if kind == ONEWAY:
+                    # inline fast path for handlers that opt in (standing
+                    # channel frames): a synchronous, non-blocking handler
+                    # runs right here, skipping a dispatch-task round on
+                    # the loop — the per-hop hot path of compiled DAGs
+                    fn = getattr(self.handler, f"rpc_{method}", None)
+                    if fn is not None and getattr(fn, "_rpc_inline", False):
+                        try:
+                            fn(**payload)
+                        except Exception:
+                            self._stat(method)["errors"] += 1
+                        continue
                 t = asyncio.get_running_loop().create_task(
                     self._dispatch(writer, kind, msg_id, method, payload))
                 self._dispatches.add(t)
@@ -369,7 +382,11 @@ class EventLoopThread:
                 # documented contract (CancelledError is a BaseException —
                 # callers' `except Exception` handlers never see it)
                 raise ConnectionLost("runtime event loop stopped") from None
-            except TimeoutError:
+            except (TimeoutError, _futures.TimeoutError):
+                # both spellings: before 3.11 concurrent.futures'
+                # TimeoutError is NOT the builtin, and fut.result raises
+                # the futures one — catching only the builtin turns every
+                # >0.5s coroutine into a spurious timeout
                 if fut.done():
                     # Completed during the poll window: surface the real
                     # outcome (result, or the coroutine's own exception).
@@ -379,7 +396,10 @@ class EventLoopThread:
                     raise ConnectionLost("runtime event loop stopped") from None
                 if deadline is not None and _time.monotonic() >= deadline:
                     fut.cancel()
-                    raise
+                    # normalize to the builtin so callers need one spelling
+                    raise TimeoutError(
+                        f"coroutine did not finish within {timeout}s"
+                    ) from None
 
     def spawn(self, coro):
         """Fire-and-forget from any thread."""

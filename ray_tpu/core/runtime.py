@@ -433,6 +433,9 @@ class Runtime:
         # imports this module.
         from ray_tpu.observability.agent import TelemetryAgent
         self.telemetry = TelemetryAgent(self)
+        # compiled-DAG output sinks by id: channel_result frames from the
+        # leaf workers land here (core/channels.py, dag/compiled.py)
+        self._channel_sinks: Dict[str, Any] = {}
         self._gcs_subs: Set[str] = set()  # channels to restore on failover
         self._recon_lock = threading.Lock()  # serializes reconstructions
         self._gcs_sub_gen: Optional[int] = None  # conn generation at last sub
@@ -2087,6 +2090,36 @@ class Runtime:
                     pass
         elif channel == "log":
             self._on_log(message)
+
+    # ------------------------------------------------- compiled-DAG sinks
+
+    def register_channel_sink(self, sink_id: str, sink: Any) -> None:
+        """Accept channel_result frames for one CompiledDAG's output."""
+        self._channel_sinks[sink_id] = sink
+
+    def unregister_channel_sink(self, sink_id: str) -> None:
+        self._channel_sinks.pop(sink_id, None)
+
+    def deliver_channel_result(self, sink_id: str, seq: int, slot: int,
+                               kind: str, payload: bytes) -> bool:
+        """Local fast path for a leaf channel hosted in this process;
+        returns False when the sink is gone (torn down)."""
+        sink = self._channel_sinks.get(sink_id)
+        if sink is None:
+            return False
+        sink.deliver(seq, slot, kind, payload)
+        return True
+
+    def rpc_channel_result(self, sink_id: str, seq: int, slot: int,
+                           kind: str, payload: bytes) -> dict:
+        # synchronous up to the enqueue (frames keep wire order) and
+        # inline-eligible: ONEWAY results skip the dispatch-task round
+        if not self.deliver_channel_result(sink_id, seq, slot, kind,
+                                           payload):
+            return {"ok": False, "error": "no such sink"}
+        return {"ok": True}
+
+    rpc_channel_result._rpc_inline = True
 
     def _on_log(self, message: dict):
         """Driver-side worker log fan-in (ref: worker.py:1758

@@ -23,6 +23,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
 
 from ray_tpu.core import serialization
+from ray_tpu.core.channels import ChannelHost
 from ray_tpu.core.common import ObjectRef, RuntimeAddress, TaskResult, TaskSpec
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import JobID, NodeID, ObjectID, TaskID
@@ -124,6 +125,22 @@ class Worker:
         self._exec_threads: dict = {}
         self._cancelled: set = set()
         self._cancel_lock = threading.Lock()
+        # standing channels of compiled DAGs whose nodes live on this
+        # worker's lanes (dag.compiled); negotiated once at channel_open
+        self.channels = ChannelHost(self)
+
+    async def rpc_channel_open(self, spec) -> dict:
+        return await self.channels.rpc_channel_open(spec)
+
+    def rpc_channel_push(self, channel_id, seq, slot, kind,
+                         payload) -> dict:
+        return self.channels.push(channel_id, seq, slot, kind, payload)
+
+    rpc_channel_push._rpc_inline = True   # sync + non-blocking: ONEWAY
+    # frames dispatch inline in the server reader loop (rpc.py)
+
+    async def rpc_channel_close(self, channel_id) -> dict:
+        return await self.channels.rpc_channel_close(channel_id)
 
     def __getattr__(self, name):
         # Delegate rpc_wait_object / rpc_locate / rpc_add_borrow / ... to the

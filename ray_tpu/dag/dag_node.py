@@ -17,6 +17,16 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+# Structure generation: bumped on any rebind() anywhere, so cached
+# topological orders (kept per root node) invalidate without every node
+# needing a back-pointer to the roots that traversed it.
+_struct_gen = 0
+
+
+def _bump_struct_gen() -> None:
+    global _struct_gen
+    _struct_gen += 1
+
 
 class DAGNode:
     """Base: immutable bound (args, kwargs); children are nested DAGNodes."""
@@ -24,6 +34,7 @@ class DAGNode:
     def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
         self._bound_args = args
         self._bound_kwargs = kwargs
+        self._topo_cache: Optional[Tuple[int, List["DAGNode"]]] = None
 
     # -- traversal ------------------------------------------------------------
 
@@ -58,17 +69,41 @@ class DAGNode:
                 out.append(c)
         return out
 
+    def _topo_order(self) -> List["DAGNode"]:
+        """Topological order ending at self, cached on this root; the walk
+        reruns only after a rebind() somewhere in the graph."""
+        cached = self.__dict__.get("_topo_cache")
+        if cached is not None and cached[0] == _struct_gen:
+            return cached[1]
+        order = self._walk() + [self]
+        self._topo_cache = (_struct_gen, order)
+        return order
+
+    def rebind(self, *args, **kwargs) -> "DAGNode":
+        """Replace this node's bound arguments in place. Invalidates every
+        cached topological order (structure may have changed)."""
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+        _bump_struct_gen()
+        return self
+
     # -- execution ------------------------------------------------------------
 
     def execute(self, *input_args, **input_kwargs):
         """Run the DAG; returns ObjectRef(s) for the root node
         (ref: DAGNode.execute)."""
         cache: Dict[int, Any] = {}
-        order = self._walk() + [self]
-        for node in order:
+        for node in self._topo_order():
             cache[id(node)] = node._execute_impl(
                 lambda v: _resolve(v, cache), input_args, input_kwargs)
         return cache[id(self)]
+
+    def experimental_compile(self, *, resolve_timeout: Optional[float] = 60.0):
+        """Compile this bound DAG into a CompiledDAG driving standing
+        channels — see dag/compiled.py."""
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, resolve_timeout=resolve_timeout)
 
     def _execute_impl(self, resolve, input_args, input_kwargs):
         raise NotImplementedError
@@ -109,7 +144,9 @@ class InputNode(DAGNode):
 
     def __getattr__(self, name):
         if name.startswith("_"):
-            raise AttributeError(name)
+            raise AttributeError(
+                f"InputNode has no attribute {name!r} (underscore names "
+                "never become InputAttributeNodes)")
         return InputAttributeNode(self, name)
 
     def _execute_impl(self, resolve, input_args, input_kwargs):
@@ -170,11 +207,14 @@ class ClassNode(DAGNode):
         super().__init__(args, kwargs)
         self._actor_cls = actor_cls
         self._handle = None
+        self._external = False  # bind_actor: caller owns the lifecycle
         self._lock = threading.Lock()
 
     def __getattr__(self, name):
         if name.startswith("_"):
-            raise AttributeError(name)
+            raise AttributeError(
+                f"ClassNode has no attribute {name!r} (underscore names "
+                "never bind as actor methods)")
         return _UnboundMethod(self, name)
 
     def _get_handle(self, resolve):
@@ -219,6 +259,16 @@ class ClassMethodNode(DAGNode):
 
     def __repr__(self):
         return f"ClassMethodNode(.{self._method})"
+
+
+def bind_actor(handle) -> ClassNode:
+    """Wrap an already-running actor's handle as a ClassNode, so a graph
+    can route through externally-owned actors (e.g. serve replicas). The
+    compiled layer never kills these at teardown."""
+    node = ClassNode(None, (), {})
+    node._handle = handle
+    node._external = True
+    return node
 
 
 class MultiOutputNode(DAGNode):

@@ -352,13 +352,13 @@ class Dataset:
 
         from ray_tpu.data.execution import build_pipeline, get_context
 
-        if (self._ops and self._block_refs and
-                get_context().resolve_policy(None, len(self._ops))
-                == "streaming"):
+        pol = (get_context().resolve_policy(None, len(self._ops))
+               if self._ops and self._block_refs else "fused")
+        if pol in ("streaming", "compiled"):
             # budget-aware drain: transformed blocks land in the store in
             # source order; unconsumed bytes stay under the executor budget
-            refs = build_pipeline(self._block_refs,
-                                  self._ops).execute_to_refs()
+            refs = build_pipeline(self._block_refs, self._ops,
+                                  policy=pol).execute_to_refs()
             return Dataset(refs, [])
         refs = self._executed_refs()
         ray_tpu.wait(refs, num_returns=len(refs))
@@ -416,8 +416,9 @@ class Dataset:
             return
         from ray_tpu.data.execution import build_pipeline, get_context
 
-        if get_context().resolve_policy(policy, len(ops)) == "streaming":
-            for bundle in build_pipeline(refs, ops).execute():
+        pol = get_context().resolve_policy(policy, len(ops))
+        if pol in ("streaming", "compiled"):
+            for bundle in build_pipeline(refs, ops, policy=pol).execute():
                 yield ray_tpu.get(bundle.block_ref)
             return
         w = min(_WINDOW, len(refs))

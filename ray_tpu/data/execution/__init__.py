@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
+from ray_tpu.data.execution.compiled_map import CompiledChainMapOperator
 from ray_tpu.data.execution.context import DataContext, get_context
 from ray_tpu.data.execution.interfaces import (BlockMeta, OpBuffer,
                                                OpMetrics, PhysicalOperator,
@@ -38,18 +39,31 @@ from ray_tpu.data.execution.streaming_executor import (
 
 def build_pipeline(block_refs: List[Any], logical_ops: List[tuple],
                    *, split: Optional[int] = None,
-                   context: Optional[DataContext] = None
+                   context: Optional[DataContext] = None,
+                   policy: Optional[str] = None
                    ) -> StreamingExecutor:
     """Compile a Dataset plan into a StreamingExecutor: one
     TaskPoolMapOperator per logical op (each independently scheduled —
     that's the cross-operator pipelining), plus an optional
-    OutputSplitter sink for per-host shard iterators."""
+    OutputSplitter sink for per-host shard iterators. Under
+    policy="compiled" the whole chain fuses into one
+    CompiledChainMapOperator riding standing channels instead."""
     ctx = context or get_context()
     max_in_flight = ctx.resolved_max_tasks_per_op()
     ops: List[PhysicalOperator] = [InputDataBuffer(block_refs)]
-    for spec in logical_ops:
-        ops.append(TaskPoolMapOperator(
-            spec[0], [spec], ops[-1], max_in_flight=max_in_flight))
+    if policy == "compiled" and logical_ops:
+        from ray_tpu.data.execution.compiled_map import \
+            CompiledChainMapOperator
+
+        name = "+".join(spec[0] for spec in logical_ops)
+        ops.append(CompiledChainMapOperator(
+            name, logical_ops, ops[-1],
+            pool_size=ctx.compiled_pool_size,
+            max_in_flight=max_in_flight))
+    else:
+        for spec in logical_ops:
+            ops.append(TaskPoolMapOperator(
+                spec[0], [spec], ops[-1], max_in_flight=max_in_flight))
     if split is not None:
         ops.append(OutputSplitter(ops[-1], split))
     rm = ResourceManager(
@@ -61,7 +75,8 @@ def build_pipeline(block_refs: List[Any], logical_ops: List[tuple],
 
 
 __all__ = [
-    "ActorPoolMapOperator", "BlockMeta", "DataContext", "InputDataBuffer",
+    "ActorPoolMapOperator", "BlockMeta", "CompiledChainMapOperator",
+    "DataContext", "InputDataBuffer",
     "OpBuffer", "OpMetrics", "OutputSplitter", "PhysicalOperator",
     "RefBundle", "ResourceManager", "StreamingExecutor", "build_pipeline",
     "derive_budget_bytes", "get_context", "get_last_execution_stats",

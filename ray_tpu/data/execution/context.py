@@ -10,13 +10,16 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-_POLICIES = ("auto", "fused", "streaming")
+_POLICIES = ("auto", "fused", "streaming", "compiled")
 
 
 @dataclass
 class DataContext:
     #: "auto" (fused for single-op chains, streaming otherwise),
-    #: "fused" (the legacy windowed generator path), or "streaming"
+    #: "fused" (the legacy windowed generator path), "streaming", or
+    #: "compiled" (whole chain fused onto a compiled-graph actor pool —
+    #: standing channels, no per-block task dispatch; opt-in, never
+    #: chosen by "auto")
     execution_policy: str = "auto"
     #: overrides Config.data_execution_budget_fraction when set
     budget_fraction: Optional[float] = None
@@ -24,6 +27,8 @@ class DataContext:
     per_op_budget_bytes: Optional[int] = None
     #: max concurrent tasks per operator (None -> Config value)
     max_tasks_per_op: Optional[int] = None
+    #: actor-pool width for the "compiled" policy's fused chain operator
+    compiled_pool_size: int = 2
 
     _current: "Optional[DataContext]" = None
 

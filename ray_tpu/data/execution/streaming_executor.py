@@ -100,6 +100,10 @@ class StreamingExecutor:
                 refs.extend(op.watch_refs())
             if refs:
                 ray_tpu.wait(refs, num_returns=1, timeout=0.1)
+            elif any(op.num_in_flight() > 0 for op in self._ops):
+                # compiled-graph operators track in-flight work as
+                # channel refs, not ObjectRefs — nothing to wait() on
+                time.sleep(0.01)
             elif not self.done():
                 # structurally unreachable: bundles are always in some
                 # queue, making an operator input-ready, and an idle

@@ -37,6 +37,15 @@ mid-stream replica death by resubmitting prompt + tokens-generated-so-far
 replica. The client-visible stream continues with no duplicated or
 dropped tokens — the resubmission's prompt IS the already-emitted
 sequence, so the new replica only ever generates the continuation.
+
+Stream-frame transport: with ``llm_router_compiled_hop`` (default on)
+the router compiles one standing two-node graph per replica —
+``InputNode -> replica.handle_request_streaming`` (dag/compiled.py) —
+and each request is a raw channel enqueue; token frames ride the
+standing channel back instead of paying per-call ``.remote()`` dispatch
+plus a driver-mediated ref per frame. Replica death still surfaces as
+``ActorDiedError`` from the frame iterator, feeding the same failover
+path; compile failures fall back to the legacy per-call hop.
 """
 
 from __future__ import annotations
@@ -56,15 +65,25 @@ from ray_tpu.util.tracing import span
 _END = object()
 
 
-def _next_item(gen):
+def _next_item(frames):
     """One blocking stream step (runs on an executor thread: raylint
     blocking-in-async). Raises the replica's ActorDiedError here when it
     died mid-stream — the async caller re-routes."""
     try:
-        ref = next(gen)
+        return next(frames)
     except StopIteration:
         return _END
-    return ray_tpu.get(ref)
+
+
+def _legacy_frames(gen):
+    """Frame iterator over the per-call dispatch path: each step submits
+    nothing new but pulls the next streamed ObjectRef and resolves it."""
+    while True:
+        try:
+            ref = next(gen)
+        except StopIteration:
+            return
+        yield ray_tpu.get(ref)
 
 
 def prefix_hash(tokens: List[int], n: int) -> str:
@@ -90,12 +109,17 @@ class LLMRouter:
                  overload_factor: Optional[float] = None,
                  stats_interval_s: Optional[float] = None,
                  report_load: bool = True,
-                 max_attempts: int = 6):
+                 max_attempts: int = 6,
+                 compiled_hop: Optional[bool] = None):
         if policy not in ("affinity", "p2c", "random"):
             raise ValueError(f"unknown routing policy {policy!r}")
         self._handle = llm_handle
         self.policy = policy
         cfg = GLOBAL_CONFIG
+        self._compiled_hop = (compiled_hop if compiled_hop is not None
+                              else cfg.llm_router_compiled_hop)
+        #: replica key -> CompiledDAG of the standing stream-frame hop
+        self._compiled: Dict[str, Any] = {}
         self.prefix_tokens = (prefix_tokens if prefix_tokens is not None
                               else cfg.llm_router_prefix_tokens)
         self.max_inflight = (max_inflight if max_inflight is not None
@@ -115,7 +139,8 @@ class LLMRouter:
         self._replica_stats: Dict[str, Dict[str, Any]] = {}
         self.counters = {"requests": 0, "shed": 0, "replica_shed": 0,
                          "reroutes": 0, "affinity_picks": 0,
-                         "fallback_picks": 0}
+                         "fallback_picks": 0, "compiled_streams": 0,
+                         "legacy_streams": 0}
         try:
             me = (ray_tpu.get_runtime_context().get_actor_id() or "driver")
         except Exception:
@@ -215,7 +240,16 @@ class LLMRouter:
                 for k in list(self._replica_stats):
                     if k not in live:
                         del self._replica_stats[k]
+                stale = [(k, c) for k, c in self._compiled.items()
+                         if k not in live]
+                for k, _ in stale:
+                    del self._compiled[k]
                 depth = self._total_inflight
+            for _, comp in stale:   # off-lock: teardown RPCs block
+                try:
+                    comp.teardown(kill_actors=False)
+                except Exception:
+                    pass
             if self._report_load:
                 try:
                     controller = ray_tpu.get_actor("_serve_controller",
@@ -331,12 +365,13 @@ class LLMRouter:
                     self._inflight[key] = self._inflight.get(key, 0) + 1
                 rerouted = False
                 try:
-                    gen = replica.handle_request_streaming.remote(
-                        "stream_request", (sub,), {}, None)
+                    frames = await loop.run_in_executor(
+                        self._executor, self._open_stream, key, replica,
+                        sub)
                     while True:
                         try:
                             item = await loop.run_in_executor(
-                                self._executor, _next_item, gen)
+                                self._executor, _next_item, frames)
                         except (ray_tpu.exceptions.ActorDiedError,
                                 ray_tpu.exceptions.ActorUnavailableError
                                 ) as e:
@@ -382,6 +417,66 @@ class LLMRouter:
                 self._total_inflight = max(self._total_inflight - 1, 0)
                 self._m_inflight.set(self._total_inflight)
 
+    # ---- stream transport --------------------------------------------------
+
+    def _open_stream(self, key: str, replica, sub: dict):
+        """Open one replica stream (blocking; executor thread). Compiled
+        hop when enabled: a raw enqueue onto the replica's standing
+        channel; otherwise the per-call dispatch path."""
+        if self._compiled_hop:
+            try:
+                comp = self._compiled_for(key, replica)
+                ref = comp.execute(method="stream_request", args=(sub,),
+                                   kwargs={}, context=None)
+                with self._lock:
+                    self.counters["compiled_streams"] += 1
+                return iter(ref)
+            except (ray_tpu.exceptions.ActorDiedError,
+                    ray_tpu.exceptions.ActorUnavailableError):
+                raise
+            except Exception:
+                # compile/enqueue failure that is NOT the replica dying:
+                # drop the graph and serve via the legacy hop
+                self._drop_compiled(key)
+        with self._lock:
+            self.counters["legacy_streams"] += 1
+        gen = replica.handle_request_streaming.remote(
+            "stream_request", (sub,), {}, None)
+        return _legacy_frames(gen)
+
+    def _compiled_for(self, key: str, replica):
+        with self._lock:
+            comp = self._compiled.get(key)
+        if comp is not None:
+            return comp
+        from ray_tpu.dag import InputNode, bind_actor
+
+        with InputNode() as inp:
+            dag = bind_actor(replica).handle_request_streaming.bind(
+                inp.method, inp.args, inp.kwargs, inp.context)
+        comp = dag.experimental_compile()
+        with self._lock:
+            racing = self._compiled.get(key)
+            if racing is not None:
+                comp_, comp = comp, racing
+            else:
+                self._compiled[key] = comp
+                comp_ = None
+        if comp_ is not None:
+            comp_.teardown(kill_actors=False)
+        return comp
+
+    def _drop_compiled(self, key: str) -> None:
+        """Release a replica's standing channel off the event loop (the
+        teardown RPCs block)."""
+        with self._lock:
+            comp = self._compiled.pop(key, None)
+        if comp is not None:
+            try:
+                self._executor.submit(comp.teardown, False)
+            except RuntimeError:
+                pass   # executor already shut down (drain)
+
     def _on_replica_death(self, key: str, err) -> None:
         """Mid-stream death: evict from the shared replica view so no
         request (ours included) re-picks the corpse, then account the
@@ -389,6 +484,7 @@ class LLMRouter:
         the leak the old index-keyed Router had."""
         rt = self._handle._get_router()
         rt.evict(getattr(err, "actor_id", None) or key)
+        self._drop_compiled(key)
         with self._lock:
             self._replica_stats.pop(key, None)
             self.counters["reroutes"] += 1
@@ -442,7 +538,16 @@ class LLMRouter:
             return self._total_inflight
 
     def drain(self) -> None:
-        """Router replica retiring: stop the stats thread; in-flight
-        streams keep running (the controller waits on queue_len)."""
+        """Router replica retiring: stop the stats thread and release the
+        standing channels; in-flight streams keep running (the controller
+        waits on queue_len)."""
         self._stop.set()
+        with self._lock:
+            comps = list(self._compiled.values())
+            self._compiled.clear()
+        for comp in comps:
+            try:
+                self._executor.submit(comp.teardown, False)
+            except RuntimeError:
+                pass
         self._executor.shutdown(wait=False)
