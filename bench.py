@@ -773,19 +773,62 @@ def run_telemetry_bench(inc_iters: int = 50_000, flush_iters: int = 300,
         return 1
 
     ray_tpu.get(_nop.remote())  # warm the worker
-    t0 = time.perf_counter()
-    for _ in range(dispatch_tasks):
-        ray_tpu.get(_nop.remote())
-    untraced_s = (time.perf_counter() - t0) / dispatch_tasks
+
+    def _dispatch_cell(per_task=None, repeats=3, n=None):
+        """Best-of-N mean round trip: a ~1 ms dispatch is noisy enough
+        that a single run can swing more than the overheads measured."""
+        n = n or dispatch_tasks
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                if per_task is not None:
+                    per_task()
+                ray_tpu.get(_nop.remote())
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best
+
+    untraced_s = _dispatch_cell()
     tracing.enable()
     try:
-        t0 = time.perf_counter()
-        for _ in range(dispatch_tasks):
-            with tracing.span("bench::dispatch"):
-                ray_tpu.get(_nop.remote())
-        traced_s = (time.perf_counter() - t0) / dispatch_tasks
+        traced_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(dispatch_tasks):
+                with tracing.span("bench::dispatch"):
+                    ray_tpu.get(_nop.remote())
+            traced_s = min(traced_s,
+                           (time.perf_counter() - t0) / dispatch_tasks)
     finally:
         tracing.disable()
+
+    # 2b. health-plane overhead on the same cell: per round trip the
+    # watchdog adds exactly one Beacon.tick() (two attribute stores);
+    # per telemetry report interval the agent additionally snapshots
+    # every registered beacon off the hot path. Both are measured
+    # directly and composed — an end-to-end A/B on a shared box cannot
+    # resolve tens of nanoseconds against ±15% dispatch variance and
+    # would only report the noise. Acceptance: < 2% of a dispatch.
+    from ray_tpu.observability import health
+
+    wb = health.beacon("bench:dispatch", deadline_s=30.0)
+    wb.arm(bench=True)
+    n_ticks = 1_000_000
+    t0 = time.perf_counter()
+    for _ in range(n_ticks):
+        wb.tick()
+    tick_s = (time.perf_counter() - t0) / n_ticks
+    t0 = time.perf_counter()
+    for _ in range(1000):
+        health.snapshot_beacons()
+    snap_s = (time.perf_counter() - t0) / 1000
+    wb.disarm()
+    health.drop_beacon("bench:dispatch")
+    report_interval = getattr(rt.cfg, "telemetry_report_interval_s", 1.0)
+    # dispatches carried per report interval share one snapshot
+    dispatches_per_interval = max(report_interval / untraced_s, 1.0)
+    beacon_per_dispatch_s = tick_s + snap_s / dispatches_per_interval
+    watchdog_pct = 100.0 * beacon_per_dispatch_s / max(untraced_s, 1e-9)
 
     # 3. the edge model after a collective + object-transfer workload.
     # Each member allreduces (collective edges recorded worker-side) and
@@ -847,6 +890,9 @@ def run_telemetry_bench(inc_iters: int = 50_000, flush_iters: int = 300,
             "traced_dispatch_s": round(traced_s, 6),
             "tracing_overhead_pct": round(
                 100.0 * (traced_s - untraced_s) / max(untraced_s, 1e-9), 1),
+            "beacon_tick_s": tick_s,
+            "beacon_snapshot_s": snap_s,
+            "watchdog_overhead_pct": round(watchdog_pct, 4),
             "edge_stats": edges,
             "note": "per_flush emulates the pre-agent synchronous kv_put "
                     "per Counter.inc(); edge_stats should show populated "

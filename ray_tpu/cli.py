@@ -74,7 +74,8 @@ def cmd_list(args):
 
     fn = {"nodes": state.list_nodes, "actors": state.list_actors,
           "tasks": state.list_tasks, "jobs": state.list_jobs,
-          "edges": state.edge_stats}[args.what]
+          "edges": state.edge_stats,
+          "pgs": state.list_placement_groups}[args.what]
     print(json.dumps(fn(), indent=2, default=str))
 
 
@@ -179,6 +180,85 @@ def cmd_metrics(args):
     print(prometheus_text())
 
 
+def cmd_doctor(args):
+    """One-shot cluster health triage: nodes alive, progress beacons
+    fresh (no active stall), telemetry drop counters zero. Exits
+    non-zero when any check fails (observability/health.py)."""
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import state
+
+    summary = state.cluster_summary()
+    report = state.health_report()
+    checks = []
+
+    dead = summary.get("nodes_dead", 0)
+    checks.append(("nodes alive",
+                   summary.get("nodes_alive", 0) > 0 and dead == 0,
+                   f"{summary.get('nodes_alive', 0)} alive, {dead} dead"))
+
+    beacons = report.get("beacons", [])
+    stalled = [b for b in beacons if b.get("stalled")]
+    checks.append(("beacons fresh", not stalled,
+                   f"{len(beacons)} registered, "
+                   + (", ".join(b.get("component", "?") for b in stalled)
+                      + " stalled" if stalled else "none stalled")))
+
+    drops = {k: summary.get(k, 0.0)
+             for k in ("task_events_dropped", "telemetry_reports_dropped")}
+    checks.append(("drop counters zero",
+                   all(v == 0 for v in drops.values()),
+                   ", ".join(f"{k}={int(v)}" for k, v in drops.items())))
+
+    recent = report.get("events", [])
+    checks.append(("no recent stall/straggler events", not recent,
+                   f"{len(recent)} event(s)"
+                   + ("" if not recent else ": " + "; ".join(
+                       f"{e.get('kind')}:{e.get('component', '?')}"
+                       for e in recent[-3:]))))
+
+    failed = 0
+    for name, ok, detail in checks:
+        print(f"[{'ok' if ok else 'FAIL'}] {name}: {detail}")
+        failed += 0 if ok else 1
+    if args.verbose:
+        print(json.dumps(report, indent=2, default=str))
+    if failed:
+        raise SystemExit(f"doctor: {failed} check(s) failed")
+    print("doctor: all checks passed")
+
+
+def cmd_blackbox(args):
+    """Flight-recorder post-mortems: list the dumps a crashed/stalled
+    process left behind, render one, or merge into a chrome trace
+    (observability/flight.py)."""
+    from ray_tpu.observability import flight
+
+    dumps = flight.list_dumps(args.dir)
+    if not dumps:
+        print(f"no flight dumps under {args.dir or '(session dir)'}")
+        return
+    if args.list or (args.index is None and not args.chrome):
+        for i, path in enumerate(dumps):
+            try:
+                doc = flight.load_dump(path)
+                print(f"[{i}] {path}  reason={doc.get('reason')} "
+                      f"worker={doc.get('worker')} "
+                      f"events={len(doc.get('events', []))}")
+            except Exception as e:
+                print(f"[{i}] {path}  (unreadable: {e})")
+        return
+    idx = args.index if args.index is not None else len(dumps) - 1
+    if not 0 <= idx < len(dumps):
+        raise SystemExit(f"no dump [{idx}] ({len(dumps)} found)")
+    doc = flight.load_dump(dumps[idx])
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(flight.to_chrome(doc), f)
+        print(f"wrote chrome trace to {args.chrome}")
+        return
+    print(flight.render_summary(doc, tail=args.tail))
+
+
 def cmd_serve(args):
     """serve deploy/status/shutdown (ref: serve/scripts.py CLI)."""
     ray_tpu = _connect(args.address)
@@ -251,9 +331,28 @@ def main():
 
     s = sub.add_parser("list")
     s.add_argument("what", choices=["nodes", "actors", "tasks", "jobs",
-                                    "edges"])
+                                    "edges", "pgs"])
     s.add_argument("--address", required=True)
     s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("doctor", help="cluster health triage: nodes, "
+                       "beacons, drop counters (non-zero exit on failure)")
+    s.add_argument("--address", required=True)
+    s.add_argument("--verbose", action="store_true",
+                   help="also print the full health report")
+    s.set_defaults(fn=cmd_doctor)
+
+    s = sub.add_parser("blackbox",
+                       help="list/render flight-recorder post-mortems")
+    s.add_argument("--dir", default=None,
+                   help="dump directory (default: the flight default dir)")
+    s.add_argument("--list", action="store_true")
+    s.add_argument("--index", type=int, default=None,
+                   help="which dump to render (default: newest)")
+    s.add_argument("--chrome", default=None,
+                   help="write the dump as a chrome trace to this path")
+    s.add_argument("--tail", type=int, default=20)
+    s.set_defaults(fn=cmd_blackbox)
 
     s = sub.add_parser("timeline")
     s.add_argument("--address", required=True)

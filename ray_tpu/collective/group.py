@@ -30,6 +30,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu.collective.errors import CollectiveError, CollectiveTimeoutError
 from ray_tpu.collective.topology import Topology
+from ray_tpu.observability import health as _health
 from ray_tpu.observability.edges import record_transfer
 
 #: Sentinel dict key marking a server-side timeout reply.
@@ -355,6 +356,13 @@ class GroupContext:
         # bandwidth from bulk ones.
         self.coord_lat_ewma: Optional[float] = None
         self.coord_bw_ewma: Optional[float] = None
+        # Progress beacon for the watchdog (observability/health.py):
+        # armed around every blocking wait with the op + rank it waits
+        # on, so a hung round is flagged as a StallEvent naming the
+        # suspect rank — typically long before timeout_s fires.
+        self._beacon = _health.beacon(
+            f"collective:{name}:r{rank}",
+            deadline_s=float(cfg.collective_stall_deadline_s))
 
         coord_name = _actor_name(name)
         mbx_name = _actor_name(name, f"_mbx{rank}")
@@ -402,10 +410,18 @@ class GroupContext:
         self.stats.sends += 1
         self.stats.coord_sends += 1
         t0 = time.perf_counter()
-        out = self._checked_get(
-            self.coord.exchange.remote(op, self.seq, self.rank, data, t),
-            op=op, budget_s=t)
+        self._beacon.arm(op=op, seq=self.seq, phase="coord",
+                         waiting_on="coordinator")
+        try:
+            out = self._checked_get(
+                self.coord.exchange.remote(op, self.seq, self.rank, data, t),
+                op=op, budget_s=t)
+        finally:
+            self._beacon.tick()
+            self._beacon.disarm()
         if _is_timeout(out):
+            self._flight_dump(f"collective:{op}:coord_timeout",
+                              suspect_ranks=out[TIMEOUT_KEY], seq=self.seq)
             raise CollectiveTimeoutError(
                 f"collective {op} (group {self.name!r}, seq {self.seq}) "
                 f"timed out after {t:.1f}s waiting for ranks {out[TIMEOUT_KEY]}",
@@ -578,11 +594,19 @@ class GroupContext:
         the caller MUST forward() it onward — the downstream ranks and
         the owner's pinned copy are waiting on that chain."""
         t0 = time.perf_counter()
-        out = self._checked_get(
-            self.mailbox.take.remote(key, self.timeout_s),
-            op=op, budget_s=self.timeout_s)
+        self._beacon.arm(op=op, seq=self.seq, key=key,
+                         waiting_on_rank=src_rank)
+        try:
+            out = self._checked_get(
+                self.mailbox.take.remote(key, self.timeout_s),
+                op=op, budget_s=self.timeout_s)
+        finally:
+            self._beacon.tick()
+            self._beacon.disarm()
         if _is_timeout(out):
             suspects = self.probe_peers()
+            self._flight_dump(f"collective:{op or 'op'}:recv_timeout",
+                              suspect_ranks=suspects or [src_rank], key=key)
             detail = suspects or "none — peers alive but round stalled"
             raise CollectiveTimeoutError(
                 f"collective {op or 'op'} (group {self.name!r}) timed out "
@@ -605,6 +629,9 @@ class GroupContext:
             except (ray_tpu.exceptions.GetTimeoutError,
                     ray_tpu.exceptions.ObjectLostError) as e:
                 suspects = self.probe_peers()
+                self._flight_dump(f"collective:{op or 'op'}:zc_unresolved",
+                                  suspect_ranks=suspects or [src_rank],
+                                  key=key)
                 raise CollectiveTimeoutError(
                     f"collective {op or 'op'} (group {self.name!r}): "
                     f"zero-copy chunk from rank {src_rank} (key {key!r}) "
@@ -640,12 +667,16 @@ class GroupContext:
                 ray_tpu.exceptions.ActorUnavailableError,
                 ray_tpu.exceptions.WorkerCrashedError) as e:
             suspects = self.probe_peers()
+            self._flight_dump(f"collective:{op or 'op'}:member_lost",
+                              suspect_ranks=suspects, error=repr(e))
             raise CollectiveError(
                 f"collective {op or 'op'} (group {self.name!r}) lost a "
                 f"member: {e}; unresponsive ranks: {suspects}",
                 group_name=self.name, op=op, suspect_ranks=suspects) from e
         except ray_tpu.exceptions.GetTimeoutError as e:
             suspects = self.probe_peers()
+            self._flight_dump(f"collective:{op or 'op'}:get_timeout",
+                              suspect_ranks=suspects)
             raise CollectiveTimeoutError(
                 f"collective {op or 'op'} (group {self.name!r}) timed out "
                 f"after {budget_s:.1f}s; unresponsive ranks: {suspects}",
@@ -655,6 +686,19 @@ class GroupContext:
             if isinstance(cause, (ValueError, CollectiveError)):
                 raise cause
             raise
+
+    def _flight_dump(self, reason: str, **extra) -> None:
+        """Write the black box on the way into a CollectiveError — the
+        ring still holds the rounds leading up to the failure. Never
+        lets recording problems mask the collective error itself."""
+        try:
+            from ray_tpu import _rt
+            rt = _rt.get_runtime()
+            rt.flight.dump(reason, extra=dict(
+                extra, group=self.name, rank=self.rank, world=self.world,
+                seq=self.seq))
+        except Exception:
+            pass
 
     def probe_peers(self, probe_timeout_s: float = 3.0) -> List[int]:
         """Ping every peer mailbox; return ranks that did not answer."""
@@ -692,6 +736,7 @@ class GroupContext:
         """Kill every helper actor this rank can name (idempotent)."""
         self._zc_inflight.clear()
         self._zc_bytes = 0
+        _health.drop_beacon(self._beacon.component)
         for name in ([_actor_name(self.name)]
                      + [_actor_name(self.name, f"_mbx{r}")
                         for r in range(self.world)]):

@@ -46,6 +46,34 @@ from ray_tpu.core.status import ActorDiedError, RayTpuError
 
 logger = logging.getLogger("ray_tpu.channels")
 
+# Standing-channel instruments, created on first channel_open (lazy so
+# importing this module never pulls util.metrics -> runtime). Held in a
+# module global because the metrics registry is weak.
+_instruments = None
+
+
+def _channel_instruments():
+    global _instruments
+    if _instruments is None:
+        from ray_tpu.util import metrics
+        _instruments = (
+            metrics.Gauge(
+                "ray_tpu_channel_queue_depth",
+                "executions buffered in a standing channel's seq gather "
+                "map (arrived but not yet dispatched)", tag_keys=("channel",)),
+            metrics.Gauge(
+                "ray_tpu_channel_inflight_seq",
+                "next execution sequence a standing channel will dispatch "
+                "(monotonic progress indicator)", tag_keys=("channel",)),
+            metrics.Histogram(
+                "ray_tpu_channel_hop_seconds",
+                "per-hop forward latency along compiled-DAG channel edges",
+                boundaries=[1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3,
+                            0.01, 0.05, 0.1, 0.5, 1.0],
+                tag_keys=("channel",)),
+        )
+    return _instruments
+
 # frame kinds
 F_DATA = "data"    # one packed value
 F_ERR = "err"      # packed exception; poisons this seq downstream
@@ -147,6 +175,13 @@ class ChannelHost:
         self.worker = worker
         self.runtime = worker.runtime
         self._channels: Dict[str, _Channel] = {}
+        # one progress beacon for this host's channel reader: armed while
+        # any channel holds partially-gathered / out-of-order seqs (the
+        # compiled-graph wedge signature: an upstream stopped pushing
+        # mid-execution), ticked on every frame
+        from ray_tpu.observability import health
+        self._beacon = health.beacon("channels", deadline_s=30.0)
+        self._gauges = _channel_instruments()
 
     # ------------------------------------------------------------ rpc surface
 
@@ -179,6 +214,12 @@ class ChannelHost:
         if seq < ch.next_seq:
             return   # stale duplicate of an already-dispatched seq
         ch.frames.setdefault(seq, {})[slot] = (kind, payload)
+        fl = getattr(self.runtime, "flight", None)
+        if fl is not None:
+            fl.record({"kind": "channel_frame", "ts": time.time(),
+                       "channel": ch.spec.label or ch.spec.channel_id[:8],
+                       "seq": seq, "slot": slot, "frame_kind": kind,
+                       "nbytes": len(payload)})
         # dispatch strictly in seq order: pipelined executions whose frames
         # raced ahead wait in the gather map until their turn
         while ch.frames.get(ch.next_seq) is not None \
@@ -188,6 +229,17 @@ class ChannelHost:
             ch.next_seq += 1
             ch.dispatched += 1
             self._dispatch(ch, seq_now, slots)
+        self._beacon.tick()
+        label = ch.spec.label or ch.spec.channel_id[:8]
+        depth_g, seq_g, _hop = self._gauges
+        depth_g.set(float(len(ch.frames)), {"channel": label})
+        seq_g.set(float(ch.next_seq), {"channel": label})
+        if ch.frames:
+            self._beacon.arm(channel=label, waiting_seq=ch.next_seq,
+                             buffered=len(ch.frames))
+        elif self._beacon.busy \
+                and not any(c.frames for c in self._channels.values()):
+            self._beacon.disarm()
 
     def _dispatch(self, ch: _Channel, seq: int,
                   slots: Dict[int, Tuple[str, bytes]]) -> None:
@@ -367,6 +419,8 @@ class ChannelHost:
                 f"dag:{ch.spec.label or ch.spec.channel_id[:8]}",
                 f"dag:{edge.label or edge.target[:8]}",
                 nbytes, seconds, kind="dag_channel")
+            self._gauges[2].observe(
+                seconds, {"channel": ch.spec.label or ch.spec.channel_id[:8]})
         except Exception:
             pass
 

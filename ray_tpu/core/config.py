@@ -161,6 +161,23 @@ class Config:
     # events, spans, and edge observations accumulate locally and ship in
     # ONE GCS report per interval (ref: metrics_agent.py batched push).
     telemetry_report_interval_s: float = 1.0
+    # --- health plane (observability/health.py) -----------------------------
+    # Flight recorder: bounded per-process ring of recent task events /
+    # spans / channel-frame metadata, dumped to a post-mortem JSON under
+    # flight_recorder_dir ("" -> /tmp/ray_tpu/flight) on stall detection,
+    # uncaught worker exception, or CollectiveError. 0 disables.
+    flight_recorder_size: int = 2048
+    flight_recorder_dir: str = ""
+    # A RUNNING task older than straggler_k x p95 of its completed
+    # same-name peers (needs >= straggler_min_peers completions) raises a
+    # straggler event in health_report() and a timeline instant.
+    straggler_k: float = 3.0
+    straggler_min_peers: int = 5
+    # Collective recv/coordination waits arm a progress beacon with this
+    # deadline; the GCS watchdog emits a StallEvent (naming the suspect
+    # rank) once it passes without progress — typically long before the
+    # collective's own timeout would fire.
+    collective_stall_deadline_s: float = 10.0
     log_to_driver: bool = True
 
     def override(self, d: Dict[str, Any]) -> "Config":

@@ -99,6 +99,12 @@ class GcsServer:
         # reports (in-memory: telemetry, re-learned after failover)
         from ray_tpu.observability.edges import EdgeModel
         self.edge_model = EdgeModel()
+        # stall watchdog + straggler detection over beacon snapshots
+        # riding the same telemetry reports (in-memory, like edge_model)
+        from ray_tpu.observability.health import HealthAggregator
+        self.health = HealthAggregator(
+            straggler_k=cfg.straggler_k,
+            straggler_min_peers=cfg.straggler_min_peers)
         self.pool = ClientPool()
         self.server = RpcServer(self)
         # pluggable node-picking policies (ref: scheduling/policy/)
@@ -163,6 +169,13 @@ class GcsServer:
             for nid, info in list(self.nodes.items()):
                 if info.alive and now - self.last_seen.get(nid, now) > timeout:
                     await self._on_node_death(nid, "health check timeout")
+            # watchdog sweep: beacons whose owner stopped reporting, and
+            # straggler candidates that crossed k x p95 since last report
+            try:
+                self.health.check(now)
+                self._drain_health_events()
+            except Exception:
+                logger.exception("health watchdog sweep failed")
 
     async def _on_node_death(self, node_id: NodeID, reason: str):
         info = self.nodes.get(node_id)
@@ -173,6 +186,9 @@ class GcsServer:
         # drop the dead node's agent-pushed stats: the dashboard must not
         # export a frozen last sample forever
         self.kv.pop(("node_stats", node_id.binary()), None)
+        # ...and its beacons: node death is already attributed; those
+        # loops must not also fire as anonymous stalls
+        self.health.forget_node(node_id.hex())
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         await self._publish("node", {"node_id": node_id, "alive": False})
         # Restart actors that lived there (ref: gcs_actor_manager.cc:1100).
@@ -580,6 +596,16 @@ class GcsServer:
                              "resources": b["resources"].quantities}
                             for b in pg["bundles"]]}
 
+    async def rpc_list_placement_groups(self) -> List[dict]:
+        """All placement groups in rpc_get_placement_group's view shape
+        (ref: GcsPlacementGroupManager::HandleGetAllPlacementGroup)."""
+        return [{"pg_id": pg_id, "state": pg["state"],
+                 "strategy": pg["strategy"], "name": pg["name"],
+                 "bundles": [{"index": b["index"], "node_id": b["node_id"],
+                              "resources": b["resources"].quantities}
+                             for b in pg["bundles"]]}
+                for pg_id, pg in self.pgs.items()]
+
     async def rpc_wait_placement_group(self, pg_id: PlacementGroupID,
                                        wait_timeout: float = 30.0) -> dict:
         deadline = time.time() + wait_timeout
@@ -647,6 +673,8 @@ class GcsServer:
     async def rpc_add_task_events(self, events: List[dict]) -> dict:
         # ref: gcs_task_manager.h bounded task-event store for observability.
         self.task_events.extend(events)
+        for ev in events:
+            self.health.observe_task_event(ev)
         return {"ok": True}
 
     async def rpc_telemetry_report(self, report: dict) -> dict:
@@ -654,7 +682,10 @@ class GcsServer:
         metrics_agent.py push): task events + spans extend the bounded
         event store, metric deltas merge into KV ns="metrics" (WAL'd like
         kv_put so scrapers survive failover), edge observations feed the
-        EWMA edge model."""
+        EWMA edge model, and beacon snapshots feed the stall watchdog.
+        The reply names the reporter's own stalled components so the
+        stalled process can dump its flight recorder within one report
+        interval of detection."""
         import json
 
         from ray_tpu.util.metrics import merge_payload
@@ -662,6 +693,14 @@ class GcsServer:
         events = report.get("events") or []
         if events:
             self.task_events.extend(events)
+            for ev in events:
+                self.health.observe_task_event(ev)
+        stalled: List[str] = []
+        beacons = report.get("beacons")
+        if beacons:
+            stalled = self.health.update(str(report.get("worker", "?")),
+                                         report.get("node"), beacons)
+            self._drain_health_events()
         for ob in report.get("edges") or []:
             self.edge_model.observe(ob.get("src"), ob.get("dst"),
                                     ob.get("nbytes", 0.0),
@@ -683,7 +722,49 @@ class GcsServer:
             dirty = True
         if dirty:
             self._mark_dirty()
-        return {"ok": True}
+        return {"ok": True, "stalled": stalled}
+
+    def _drain_health_events(self) -> None:
+        """New StallEvents become log lines + timeline instants, exactly
+        once each (instants render in chrome_trace as 'i' markers on a
+        per-worker health track)."""
+        for ev in self.health.drain_fresh():
+            logger.warning("health: %s %s worker=%s age=%.1fs context=%s",
+                           ev.get("kind"), ev.get("component"),
+                           ev.get("worker"), ev.get("age_s", 0.0),
+                           ev.get("context"))
+            self.task_events.append({
+                "kind": "instant",
+                "name": f"{ev.get('kind')}::{ev.get('component')}",
+                "ts": ev.get("ts"), "worker": ev.get("worker"),
+                "component": ev.get("component"),
+                "age_s": ev.get("age_s"), "context": ev.get("context"),
+            })
+
+    async def rpc_health_report(self) -> dict:
+        """The state-API / `cli doctor` view: every known beacon with
+        its freshness, recent stall/straggler events, and the telemetry
+        drop counters."""
+        import json as _json
+
+        rep = self.health.report()
+        drops = {}
+        for name in ("ray_tpu_task_events_dropped",
+                     "ray_tpu_telemetry_reports_dropped"):
+            raw = self.kv.get(("metrics", name.encode()))
+            total = 0.0
+            if raw:
+                try:
+                    payload = _json.loads(raw)
+                    total = sum(s.get("value", 0.0)
+                                for s in payload.get("series", []))
+                except Exception:
+                    total = 0.0
+            drops[name] = total
+        rep["drop_counters"] = drops
+        rep["nodes_alive"] = sum(1 for n in self.nodes.values() if n.alive)
+        rep["nodes_dead"] = sum(1 for n in self.nodes.values() if not n.alive)
+        return rep
 
     async def rpc_edge_stats(self) -> Dict[str, dict]:
         return self.edge_model.stats()

@@ -1196,8 +1196,12 @@ class Nodelet:
             "available": self.available.quantities,
             "total": self.total.quantities,
             "store_bytes": self.store.bytes_in_use(),
+            "store_capacity": self.store.capacity(),
             "store_objects": self.store.num_objects(),
             "store_evictions": self.store.num_evictions(),
+            # spilling-readiness: occupancy + pinned (unspillable) share
+            # + pin-count distribution (object_store.pin_summary)
+            **{f"store_{k}": v for k, v in self.store.pin_summary().items()},
             "spilled_objects": (self.spill.num_spilled()
                                 if self.spill is not None else 0),
             "spilled_bytes": (self.spill.bytes_spilled()

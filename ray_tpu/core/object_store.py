@@ -264,6 +264,29 @@ class SharedMemoryStore:
         return [(ObjectID(raw[i * 20:(i + 1) * 20]), int(sizes[i]),
                  int(pins[i])) for i in range(n)]
 
+    def pin_summary(self, max_entries: int = 4096) -> dict:
+        """Spilling-readiness view: how much of the store is pinned (and
+        so unspillable) and how contended the pins are. Buckets are pin
+        counts; "unpinned" objects are the spill/evict headroom.
+        (ref: local_object_manager.h — spilling skips pinned primaries)."""
+        objs = self.list_objects(max_entries)
+        pinned_bytes = 0
+        pinned_objects = 0
+        dist: Dict[str, int] = {}
+        for _oid, size, pins in objs:
+            key = str(pins) if pins < 3 else "3+"
+            dist[key] = dist.get(key, 0) + 1
+            if pins > 0:
+                pinned_bytes += size
+                pinned_objects += 1
+        cap = self.capacity()
+        return {
+            "occupancy": (self.bytes_in_use() / cap) if cap else 0.0,
+            "pinned_bytes": pinned_bytes,
+            "pinned_objects": pinned_objects,
+            "pin_count_distribution": dist,
+        }
+
     # -- stats ---------------------------------------------------------------
 
     # ---- native transfer plane (xfer.cc) -----------------------------------

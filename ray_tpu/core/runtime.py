@@ -433,6 +433,12 @@ class Runtime:
         # imports this module.
         from ray_tpu.observability.agent import TelemetryAgent
         self.telemetry = TelemetryAgent(self)
+        # Per-process flight recorder: bounded ring of recent task
+        # events/spans/channel frames, dumped as a post-mortem on stall
+        # detection, uncaught worker exceptions, or CollectiveError
+        # (observability/flight.py; rendered by `cli blackbox`).
+        from ray_tpu.observability.flight import FlightRecorder
+        self.flight = FlightRecorder(self)
         # compiled-DAG output sinks by id: channel_result frames from the
         # leaf workers land here (core/channels.py, dag/compiled.py)
         self._channel_sinks: Dict[str, Any] = {}
@@ -1919,6 +1925,9 @@ class Runtime:
                 self._queues[cls].append(spec)
                 self._spawn(self._pump_class(cls))
             else:
+                asyncio.get_running_loop().run_in_executor(
+                    None, self.flight.dump, f"worker_crashed:{spec.name}",
+                    {"task_id": spec.task_id.hex(), "error": str(e)})
                 self._fail_task_returns(spec, WorkerCrashedError(
                     f"worker died running {spec.name}: {e}"))
             return False
@@ -2220,6 +2229,14 @@ class Runtime:
                     addr = await self._resolve_actor(actor_id)
                 except (ActorDiedError, ActorUnavailableError) as e:
                     e.dispatched = False   # never left the submit queue
+                    if isinstance(e, ActorDiedError):
+                        # black box: the dead worker itself may never have
+                        # dumped (SIGKILL / os._exit) — the caller's ring
+                        # is the remaining evidence. Off-loop: file I/O.
+                        asyncio.get_running_loop().run_in_executor(
+                            None, self.flight.dump,
+                            f"actor_died:{actor_id.hex()[:12]}",
+                            {"cause": str(e)})
                     self._fail_task_returns(spec, e)
                     continue
                 except (ConnectionLost, RemoteError, OSError):
@@ -2310,6 +2327,14 @@ class Runtime:
                 dispatched=dispatched))
         else:
             cause = (view or {}).get("death_cause", str(err))
+            # driver-side black box: the dead actor's worker may have had
+            # no chance to dump (SIGKILL), so the caller's recent task
+            # events are the only post-mortem evidence. Off-loop: dump()
+            # writes a file.
+            loop = asyncio.get_running_loop()
+            loop.run_in_executor(None, self.flight.dump,
+                                 f"actor_died:{actor_id.hex()[:12]}",
+                                 {"cause": str(cause)})
             self._fail_task_returns(spec, ActorDiedError(
                 f"actor {actor_id.hex()[:12]} died: {cause}",
                 actor_id=actor_id.hex(), dispatched=dispatched))

@@ -522,6 +522,40 @@ class Worker:
         return {"ok": True}
 
 
+def _install_flight_hooks(runtime) -> None:
+    """Uncaught exceptions (main thread, lane threads, daemon helpers)
+    write the flight recorder on the way down — the last thing a dying
+    worker does is label its own black box. Task-raised exceptions are
+    NOT uncaught (they travel as typed error results) and don't trip
+    this."""
+    import sys
+    import threading as _threading
+
+    prev_sys = sys.excepthook
+    prev_thread = _threading.excepthook
+
+    def _dump(where: str, exc_type, exc) -> None:
+        try:
+            runtime.flight.dump(
+                f"uncaught:{exc_type.__name__}",
+                extra={"where": where, "error": repr(exc)}, force=True)
+        except Exception:
+            pass
+
+    def _sys_hook(exc_type, exc, tb):
+        _dump("main", exc_type, exc)
+        prev_sys(exc_type, exc, tb)
+
+    def _thread_hook(hook_args):
+        if not issubclass(hook_args.exc_type, SystemExit):
+            _dump(hook_args.thread.name if hook_args.thread else "thread",
+                  hook_args.exc_type, hook_args.exc_value)
+        prev_thread(hook_args)
+
+    sys.excepthook = _sys_hook
+    _threading.excepthook = _thread_hook
+
+
 async def worker_main(args):
     cfg = Config.from_json(args.config)
     gh, gp = args.gcs.rsplit(":", 1)
@@ -532,6 +566,7 @@ async def worker_main(args):
                       worker_id=bytes.fromhex(args.worker_id),
                       node_id=args.node_id)
     set_runtime(runtime)
+    _install_flight_hooks(runtime)
     worker = Worker(runtime)
     runtime.server.handler = worker
     host, port = await runtime.server.start()

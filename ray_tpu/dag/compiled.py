@@ -56,13 +56,16 @@ _WAIT_SLICE_S = 0.05
 class _PendingExec:
     """Sink-side state of one in-flight sequence number."""
 
-    __slots__ = ("frames", "error", "items", "stream_ended")
+    __slots__ = ("frames", "error", "items", "stream_ended", "t0",
+                 "span_emitted")
 
     def __init__(self):
         self.frames: Dict[int, bytes] = {}
         self.error: Optional[BaseException] = None
         self.items: deque = deque()
         self.stream_ended = False
+        self.t0 = time.time()       # execute() wall clock, for the span
+        self.span_emitted = False
 
 
 class _ChannelSink:
@@ -74,6 +77,9 @@ class _ChannelSink:
         self.n_slots = n_slots
         self._cond = threading.Condition()
         self._pending: Dict[int, _PendingExec] = {}
+        # set by CompiledDAG: called once per seq when it completes
+        # (all slots / error / stream end) — the driver-side execute span
+        self.on_complete = None
 
     def expect(self, seq: int) -> None:
         with self._cond:
@@ -98,13 +104,29 @@ class _ChannelSink:
                 rec.stream_ended = True
             else:
                 rec.frames[slot] = payload
+            self._maybe_complete(seq, rec)
             self._cond.notify_all()
+
+    def _maybe_complete(self, seq: int, rec: _PendingExec) -> None:
+        """Under self._cond: fire on_complete exactly once per seq, when
+        its output is fully determined."""
+        if rec.span_emitted or self.on_complete is None:
+            return
+        done = (rec.error is not None or rec.stream_ended
+                or len(rec.frames) >= self.n_slots)
+        if done:
+            rec.span_emitted = True
+            try:
+                self.on_complete(seq, rec)
+            except Exception:
+                pass
 
     def poison(self, seq: int, err: BaseException) -> None:
         with self._cond:
             rec = self._pending.get(seq)
             if rec is not None and rec.error is None:
                 rec.error = err
+                self._maybe_complete(seq, rec)
                 self._cond.notify_all()
 
     def poison_all(self, err: BaseException) -> None:
@@ -402,6 +424,13 @@ class CompiledDAG:
         self._streaming = len(leaves) == 1
 
         self._sink = _ChannelSink(self._sink_id, n_slots=len(leaves))
+        # driver-side span per execute: expect() stamps t0 at execute
+        # time, the sink fires once when the seq's output is determined.
+        # Unconditional (unlike worker-side dag:: spans, which are
+        # tracing-gated): one event per execute is the observability
+        # floor compiled graphs otherwise lack.
+        self._label = "|".join(f"{leaf._method}" for leaf in leaves)
+        self._sink.on_complete = self._record_execute_span
         rt.register_channel_sink(self._sink_id, self._sink)
 
         # 5. channels with no inbound slots still need one frame per seq
@@ -497,6 +526,17 @@ class CompiledDAG:
                 self._sink.poison(seq, RayTpuError(
                     f"compiled-dag input push failed for seq {seq}: "
                     f"{e!r}"))
+
+    def _record_execute_span(self, seq: int, rec: _PendingExec) -> None:
+        """Runs under the sink condition on the runtime loop — must stay
+        non-blocking (record_event is lock+append)."""
+        self._rt.record_span({
+            "kind": "span", "name": f"dag::{self._label}",
+            "trace_id": f"dag:{self._sink_id[:8]}",
+            "span_id": f"{self._sink_id[:8]}:{seq}", "parent_id": None,
+            "ts": rec.t0, "dur": max(time.time() - rec.t0, 0.0),
+            "attrs": {"seq": seq, "ok": rec.error is None,
+                      "streaming": self._streaming}})
 
     # ------------------------------------------------------------ liveness
 

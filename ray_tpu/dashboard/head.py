@@ -209,6 +209,11 @@ class DashboardHead:
         src->dst node pair), fed by batched telemetry reports."""
         return self._json(await self._gcs("edge_stats"))
 
+    async def _h_health(self, request):
+        """Health plane: progress beacons with freshness, recent stall /
+        straggler events, drop counters (observability/health.py)."""
+        return self._json(await self._gcs("health_report"))
+
     async def _h_tasks(self, request):
         limit = int(request.query.get("limit", 1000))
         return self._json(await self._gcs("list_task_events", limit=limit))
@@ -530,6 +535,7 @@ class DashboardHead:
         app.router.add_get("/api/v0/summary", self._h_summary)
         app.router.add_get("/api/v0/node_stats", self._h_node_stats)
         app.router.add_get("/api/v0/edge_stats", self._h_edge_stats)
+        app.router.add_get("/api/v0/health", self._h_health)
         app.router.add_get("/metrics", self._h_metrics)
         app.router.add_get("/api/v0/logs", self._h_logs)
         self._runner = web.AppRunner(app)

@@ -23,6 +23,12 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from ray_tpu.data.execution.interfaces import PhysicalOperator, RefBundle
 from ray_tpu.data.execution.resource_manager import ResourceManager
+from ray_tpu.observability import health as _health
+
+# A scheduling round that admits nothing while work is in flight is
+# normal backpressure; one that stays that way this long without any
+# completion is a stalled pipeline (dead worker, wedged compiled op).
+_STALL_DEADLINE_S = 60.0
 
 _TRACE_CAP = 20_000
 _LAST_STATS: Optional[Dict[str, Any]] = None
@@ -43,6 +49,7 @@ class StreamingExecutor:
         self._rm = resource_manager or ResourceManager(operators)
         self._started = False
         self._shut = False
+        self._beacon = _health.beacon("data:executor", _STALL_DEADLINE_S)
         self.trace: List[Dict[str, Any]] = []
         self.peak_queued_bytes = 0
         self.max_concurrent_ops = 0   # ops with in-flight tasks at once
@@ -60,6 +67,7 @@ class StreamingExecutor:
         if self._shut:
             return
         self._shut = True
+        self._beacon.disarm()
         for op in self._ops:
             try:
                 op.shutdown()
@@ -94,6 +102,13 @@ class StreamingExecutor:
             op.submit_next()
             progressed = True
         self._record_round()
+        if progressed:
+            self._beacon.tick()
+            self._beacon.disarm()
+        elif any(op.num_in_flight() > 0 for op in self._ops) \
+                and not self._beacon.busy:
+            self._beacon.arm(ops=[op.name for op in self._ops
+                                  if op.num_in_flight() > 0])
         if not progressed:
             refs: List[Any] = []
             for op in self._ops:

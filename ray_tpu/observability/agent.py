@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu.observability import health as _health
 from ray_tpu.util import metrics as _metrics
 
 # Edge observations are tiny and summarized GCS-side; a modest bound.
@@ -52,6 +53,9 @@ class TelemetryAgent:
     # ---------------------------------------------------- recording (hot path)
 
     def record_event(self, ev: dict) -> None:
+        fl = getattr(self._rt, "flight", None)
+        if fl is not None:
+            fl.record(ev)
         cap = self._cap()
         with self._lock:
             self._events.append(ev)
@@ -155,13 +159,21 @@ class TelemetryAgent:
                     "ray_tpu_telemetry_reports_dropped",
                     "batched telemetry reports that failed to reach the GCS "
                     "(contents re-buffered)", d_rep))
-            if not (events or edges or metric_deltas or self_deltas):
+            # Beacon snapshots ride every report: the watchdog needs a
+            # fresh age even when nothing else happened — that is
+            # exactly the silent-stall case.
+            beacons = _health.snapshot_beacons()
+            if not (events or edges or metric_deltas or self_deltas
+                    or beacons):
                 return True
             report = {"events": events, "edges": edges,
-                      "metrics": metric_deltas + self_deltas}
+                      "metrics": metric_deltas + self_deltas,
+                      "beacons": beacons,
+                      "worker": self._rt.worker_id.hex()[:12],
+                      "node": getattr(self._rt, "node_id", None)}
             try:
-                self._rt.gcs_call("telemetry_report", report=report,
-                                  rpc_timeout=10.0)
+                reply = self._rt.gcs_call("telemetry_report", report=report,
+                                          rpc_timeout=10.0)
             except Exception:
                 with self._lock:
                     self.reports_dropped += 1
@@ -179,6 +191,16 @@ class TelemetryAgent:
                 self.reports_sent += 1
                 self._events_dropped_shipped += d_ev
                 self._reports_dropped_shipped += d_rep
+            # The GCS watchdog names OUR stalled components in the
+            # reply — write the black box while the evidence is still
+            # in the ring (one dump per stall episode, rate-limited).
+            stalled = (reply or {}).get("stalled") if isinstance(
+                reply, dict) else None
+            if stalled:
+                fl = getattr(self._rt, "flight", None)
+                if fl is not None:
+                    fl.dump("stall:" + ",".join(map(str, stalled)),
+                            extra={"stalled": stalled, "beacons": beacons})
             return True
 
     # ------------------------------------------------------- node resolution

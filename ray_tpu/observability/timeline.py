@@ -78,11 +78,33 @@ def chrome_trace(events: List[dict]) -> List[dict]:
                                     "attrs": ev.get("attrs", {})}),
             })
             continue
+        if ev.get("kind") in ("instant", "channel_frame"):
+            # health instants (stall::/straggler:: markers) and
+            # flight-recorder channel-frame metadata render as Chrome
+            # instant events so they line up against the slices around
+            # them
+            pid = lanes.pid(ev.get("worker"))
+            kind = ev["kind"]
+            track = ("health" if kind == "instant"
+                     else f"channel:{str(ev.get('channel', ''))[:16]}")
+            out.append({
+                "name": ev.get("name", kind), "cat": kind, "ph": "i",
+                "pid": pid, "tid": lanes.tid(pid, track),
+                "ts": ev.get("ts", 0.0) * 1e6, "s": "p",
+                "args": _jsonable({k: v for k, v in ev.items()
+                                   if k not in ("kind", "ts", "worker")}),
+            })
+            continue
         state = ev.get("state")
         task_id = ev.get("task_id")
         if task_id is None:
             continue
-        if state == "RUNNING":
+        if state == "RUNNING" or (state == "PENDING"
+                                  and task_id not in running):
+            # PENDING opens the slice only when no RUNNING is seen, so
+            # live timelines still measure execution time while
+            # driver-side flight dumps (submission states only) render
+            # instead of merging to an empty trace
             running[task_id] = ev
         elif state in _TERMINAL and task_id in running:
             start = running.pop(task_id)
@@ -106,6 +128,7 @@ def chrome_trace(events: List[dict]) -> List[dict]:
             "name": start.get("name", "task"), "cat": "task", "ph": "i",
             "pid": pid, "tid": lanes.tid(pid, f"task:{str(task_id)[:8]}"),
             "ts": start.get("ts", 0.0) * 1e6, "s": "t",
-            "args": _jsonable({"task_id": task_id, "state": "RUNNING"}),
+            "args": _jsonable({"task_id": task_id,
+                               "state": start.get("state", "RUNNING")}),
         })
     return lanes.meta + out

@@ -953,16 +953,27 @@ class LLMServer:
         self._wake = threading.Event()
         self._stop = False
         self._draining = False
+        # decode-loop progress beacon: armed while the engine has
+        # admitted work, ticked per decode block — a wedged device step
+        # (or a deadlocked engine lock) flags as a StallEvent instead of
+        # silently freezing every in-flight stream
+        from ray_tpu.observability import health as _health
+        self._beacon = _health.beacon("serve:decode", deadline_s=30.0)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _loop(self):
         while not self._stop:
             if self.engine.has_work():
+                if not self._beacon.busy:
+                    self._beacon.arm(queue=self.queue_len())
                 self.engine.step_n(self.decode_block)
+                self._beacon.tick()
             else:
+                self._beacon.disarm()
                 self._wake.wait(timeout=0.01)
                 self._wake.clear()
+        self._beacon.disarm()
 
     async def __call__(self, request) -> Dict[str, Any]:
         # handle-call payloads arrive as dicts; HTTP POSTs arrive as
@@ -1012,11 +1023,19 @@ class LLMServer:
             return
         self._wake.set()
         loop = asyncio.get_running_loop()
+        # stream-progress beacon (shared across this replica's streams):
+        # ticked per yielded frame, armed while any stream is waiting on
+        # the decode loop — no frames across the deadline = stall
+        from ray_tpu.observability import health as _health
+        sbeacon = _health.beacon("serve:stream", deadline_s=60.0)
+        if not sbeacon.busy:
+            sbeacon.arm(streaming=True)
         cursor = 0
         while True:
             new = req.generated[cursor:]
             if new:
                 cursor += len(new)
+                sbeacon.tick()
                 yield {"tokens": new}
             elif req.done_event.is_set():
                 # done was observed AFTER an empty snapshot; tokens may
@@ -1033,6 +1052,8 @@ class LLMServer:
                 await loop.run_in_executor(None, req.progress.wait, 1.0)
         ttft = (req.first_token_time - req.submit_time
                 if req.first_token_time else None)
+        sbeacon.tick()
+        sbeacon.disarm()
         out = {"done": True, "n_tokens": cursor, "ttft_s": ttft}
         if req.error:
             out["error"] = req.error

@@ -12,7 +12,13 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.observability import health as _health
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+# Step-loop progress beacon deadline: generous — "step" here means
+# report() cadence, and big-model steps plus a collective checkpoint
+# save can legitimately take minutes.
+_STEP_DEADLINE_S = 600.0
 
 
 class TrainContext:
@@ -45,7 +51,15 @@ _ctx: Optional[TrainContext] = None
 
 def _set_context(ctx: Optional[TrainContext]):
     global _ctx
+    if ctx is None and _ctx is not None:
+        _health.drop_beacon(f"train:r{_ctx.world_rank}")
     _ctx = ctx
+    if ctx is not None:
+        # armed for the whole run: a rank that stops reporting past the
+        # deadline (wedged collective, dead peer mid-allreduce) flags as
+        # a StallEvent naming the rank
+        _health.beacon(f"train:r{ctx.world_rank}", _STEP_DEADLINE_S).arm(
+            rank=ctx.world_rank, world=ctx.world_size)
 
 
 def get_context() -> TrainContext:
@@ -130,6 +144,7 @@ def report(metrics: Dict[str, Any], *, state: Any = None) -> None:
         entry["_checkpoint"] = ckpt_path
     with ctx.report_lock:
         ctx.reports.append(entry)
+    _health.beacon(f"train:r{ctx.world_rank}", _STEP_DEADLINE_S).tick()
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

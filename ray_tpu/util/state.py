@@ -55,9 +55,26 @@ def edge_stats() -> Dict[str, dict]:
 
 
 def list_placement_groups() -> List[dict]:
-    # round-1: PGs are queried per-id; a GCS listing lands with the
-    # observability milestone
-    return []
+    """ref: `ray list placement-groups` — the GCS PG table in the same
+    view shape as PlacementGroup.table()."""
+    out = []
+    for pg in rt.get_runtime().gcs_call("list_placement_groups"):
+        out.append({"pg_id": pg["pg_id"].hex(), "state": pg["state"],
+                    "strategy": pg["strategy"], "name": pg["name"],
+                    "bundles": [{"index": b["index"],
+                                 "node_id": (b["node_id"].hex()
+                                             if b["node_id"] is not None
+                                             else None),
+                                 "resources": b["resources"]}
+                                for b in pg["bundles"]]})
+    return out
+
+
+def health_report() -> dict:
+    """The health plane's view (observability/health.py): every
+    registered progress beacon with its freshness, recent stall /
+    straggler events, telemetry drop counters, node liveness."""
+    return rt.get_runtime().gcs_call("health_report")
 
 
 def summarize_tasks(limit: int = 5000) -> Dict[str, Dict[str, int]]:
@@ -84,15 +101,64 @@ def cluster_summary() -> dict:
         "available_resources": ray_tpu.available_resources(),
         "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
         "actors_total": len(actors),
+        # telemetry-plane integrity: nonzero means the observability
+        # story has holes (events dropped at the buffer, or whole
+        # reports that never reached the GCS)
+        "task_events_dropped": _metric_total("ray_tpu_task_events_dropped"),
+        "telemetry_reports_dropped": _metric_total(
+            "ray_tpu_telemetry_reports_dropped"),
     }
 
 
+def _metric_total(name: str) -> float:
+    """Cluster-wide total of one merged counter from GCS KV
+    ns="metrics" (0.0 when never incremented)."""
+    import json
+
+    raw = rt.get_runtime().gcs_call("kv_get", ns="metrics",
+                                    key=name.encode())
+    if not raw:
+        return 0.0
+    try:
+        payload = json.loads(raw)
+        return sum(s.get("value", 0.0) for s in payload.get("series", []))
+    except Exception:
+        return 0.0
+
+
 def memory_summary() -> dict:
-    """Owner-side refcount stats (ref: `ray memory` scripts.py:1900)."""
+    """Owner-side refcount stats (ref: `ray memory` scripts.py:1900)
+    plus spilling-readiness gauges: local store occupancy / pinned bytes
+    / pin-count distribution, and the same per node from the stats every
+    nodelet agent pushes to GCS KV ns="node_stats"."""
     runtime = rt.get_runtime()
     stats = runtime.refs.stats()
     stats["store_bytes_in_use"] = runtime.store.bytes_in_use()
     stats["store_capacity"] = runtime.store.capacity()
     stats["store_objects"] = runtime.store.num_objects()
     stats["store_evictions"] = runtime.store.num_evictions()
+    stats.update({f"store_{k}": v
+                  for k, v in runtime.store.pin_summary().items()})
+    # per-node store view (spilling readiness across the cluster)
+    import json
+
+    nodes: Dict[str, dict] = {}
+    try:
+        for key in runtime.gcs_call("kv_keys", ns="node_stats"):
+            raw = runtime.gcs_call("kv_get", ns="node_stats", key=key)
+            if not raw:
+                continue
+            try:
+                s = json.loads(raw)
+            except Exception:
+                continue
+            nodes[key.hex()[:12]] = {
+                k: s.get(k) for k in
+                ("store_bytes", "store_capacity", "store_occupancy",
+                 "store_pinned_bytes", "store_pinned_objects",
+                 "store_pin_count_distribution", "spilled_bytes",
+                 "spilled_objects") if k in s}
+    except Exception:
+        pass
+    stats["nodes"] = nodes
     return stats

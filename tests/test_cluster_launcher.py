@@ -35,6 +35,7 @@ system_config:
     return str(y)
 
 
+@pytest.mark.slow
 def test_up_scale_exec_down(cluster_yaml, tmp_path):
     from ray_tpu.autoscaler import launcher
 

@@ -88,7 +88,20 @@ def test_placement_group_infeasible_pending(ray_start_cluster):
     cluster.connect()
 
     pg = placement_group([{"CPU": 64}], strategy="PACK")
-    assert not pg.ready(timeout=1.5)
+    # Poll to the condition instead of one fixed-length ready() gamble:
+    # first wait until the GCS has registered the PG at all (under load
+    # the create RPC + scheduler pass can outlast a fixed 1.5s), then
+    # assert it sits PENDING — 64 CPUs can never fit on this cluster.
+    deadline = time.time() + 10
+    pg_state = None
+    while time.time() < deadline:
+        table = pg.table()
+        pg_state = table["state"] if table else None
+        if pg_state is not None:
+            break
+        time.sleep(0.05)
+    assert pg_state == "PENDING", pg_state
+    assert not pg.ready(timeout=0.5)
 
 
 def test_node_death_actor_restart(ray_start_cluster):

@@ -98,6 +98,7 @@ def test_feature_hasher(cluster):
     assert "t" not in out[0]
 
 
+@pytest.mark.slow
 def test_tokenizer_and_count_vectorizer(cluster):
     ds = data.from_items([{"s": "the cat sat"}, {"s": "the hat"}])
     toks = Tokenizer(["s"]).transform(ds).take_all()

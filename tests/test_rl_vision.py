@@ -80,6 +80,7 @@ def test_pixel_env_mechanics():
     assert len(catches) == 2            # one terminal reward per ball
 
 
+@pytest.mark.slow
 def test_ppo_cnn_learns_pixel_catcher(ray_start_regular):
     """The headline check: PPO with the NatureCNN improves reward on a
     pixel env, TPU-shaped learner + CPU rollout actors."""

@@ -92,6 +92,7 @@ def test_random_agent_baseline(cluster):
         t.stop()
 
 
+@pytest.mark.slow
 def test_r2d2_trains(cluster):
     from ray_tpu.rl import R2D2Config, R2D2Trainer
 

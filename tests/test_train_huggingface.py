@@ -67,6 +67,7 @@ def test_transformers_trainer_single_worker(cluster):
     assert any("train_runtime" in m for m in res.metrics_history)
 
 
+@pytest.mark.slow
 def test_transformers_trainer_ddp_two_workers(cluster):
     from ray_tpu.train import TransformersTrainer
 

@@ -91,6 +91,7 @@ def _tf_loop(config):
     return {"loss": loss, "w": w.numpy().ravel().tolist()}
 
 
+@pytest.mark.slow
 def test_tensorflow_trainer_multiworker(cluster, tmp_path):
     from ray_tpu.train import RunConfig, ScalingConfig, TensorflowTrainer
 
