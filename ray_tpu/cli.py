@@ -74,7 +74,7 @@ def cmd_list(args):
 
     fn = {"nodes": state.list_nodes, "actors": state.list_actors,
           "tasks": state.list_tasks, "jobs": state.list_jobs,
-          "edges": state.edge_stats,
+          "edges": state.edge_stats, "objects": state.list_objects,
           "pgs": state.list_placement_groups}[args.what]
     print(json.dumps(fn(), indent=2, default=str))
 
@@ -173,6 +173,67 @@ def cmd_memory(args):
     print(json.dumps(state.memory_summary(), indent=2, default=str))
 
 
+def _mib(n) -> str:
+    return f"{(n or 0) / (1 << 20):.2f}MiB"
+
+
+def _pin_str(rec: dict) -> str:
+    pins = rec.get("pins") or {}
+    if not pins:
+        return "-"
+    parts = []
+    for reason, p in pins.items():
+        extra = ",".join(f"{k}={v}" for k, v in p.items()
+                         if k != "count" and v is not None)
+        parts.append(f"{reason}x{p.get('count', 1)}"
+                     + (f"({extra})" if extra else ""))
+    return " ".join(parts)
+
+
+def cmd_top(args):
+    """`top mem`: cluster memory attribution (observability/memory.py)
+    — per-subsystem bytes, the biggest holders with owner / pin reasons /
+    temperature, spill candidates, leak suspects."""
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import state
+
+    rep = state.memory_report(top_n=args.limit)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+        return
+    print(f"attributed records: {rep.get('records', 0)}"
+          f" (+{rep.get('records_overflow', 0)} summarized)")
+    sub = rep.get("subsystem_bytes", {})
+    store = rep.get("subsystem_store_bytes", {})
+    hwm = rep.get("subsystem_hwm_bytes", {})
+    print("subsystem        resident      in-store         high-water")
+    for name in sorted(set(sub) | set(hwm)):
+        print(f"  {name:<12} {_mib(sub.get(name)):>12} "
+              f"{_mib(store.get(name)):>12} {_mib(hwm.get(name)):>12}")
+    for node, st in (rep.get("nodes") or {}).items():
+        print(f"node {node[:12]}: store {_mib(st.get('store_bytes'))} of "
+              f"{_mib(st.get('store_capacity'))}, attribution coverage "
+              f"{100.0 * (st.get('coverage') or 0):.1f}%")
+    print(f"top holders (of {rep.get('records', 0)}):")
+    for r in rep.get("top_holders", []):
+        print(f"  {r.get('key', '?')[:20]:<20} {r.get('subsystem'):<10} "
+              f"{_mib(r.get('nbytes')):>12}  idle={r.get('idle_s')}s "
+              f"acc={r.get('access_count')} pins={_pin_str(r)}")
+    print(f"spill candidates (unpinned, idle>={rep.get('cold_after_s')}s): "
+          f"{len(rep.get('spill_candidates', []))} object(s), "
+          f"{_mib(rep.get('spill_candidate_bytes'))}")
+    leaks = rep.get("leak_suspects", [])
+    if leaks:
+        print(f"LEAK SUSPECTS (pinned, owner dead "
+              f">={rep.get('leak_suspect_s')}s):")
+        for r in leaks:
+            print(f"  {r.get('key', '?')[:20]:<20} "
+                  f"{_mib(r.get('nbytes')):>12} orphan={r.get('orphan_s')}s "
+                  f"pins={_pin_str(r)}")
+    else:
+        print("leak suspects: none")
+
+
 def cmd_metrics(args):
     ray_tpu = _connect(args.address)
     from ray_tpu.util.metrics import prometheus_text
@@ -216,12 +277,43 @@ def cmd_doctor(args):
                        f"{e.get('kind')}:{e.get('component', '?')}"
                        for e in recent[-3:]))))
 
+    # memory plane (observability/memory.py): leak suspects fail the
+    # triage; top holders + spill-candidate bytes print as context
+    mem = {}
+    try:
+        mem = state.memory_report(top_n=50)
+    except Exception as e:
+        checks.append(("memory report reachable", False, str(e)))
+    leaks = mem.get("leak_suspects", [])
+    checks.append(("no memory leak suspects", not leaks,
+                   f"{len(leaks)} pinned object(s) with a dead owner"
+                   + ("" if not leaks else ": " + ", ".join(
+                       f"{r.get('key', '?')[:16]}({_pin_str(r)})"
+                       for r in leaks[:3]))))
+
     failed = 0
     for name, ok, detail in checks:
         print(f"[{'ok' if ok else 'FAIL'}] {name}: {detail}")
         failed += 0 if ok else 1
+
+    if mem:
+        total = sum((mem.get("subsystem_bytes") or {}).values())
+        print(f"memory: {_mib(total)} attributed "
+              f"across {mem.get('records', 0)} record(s); spill-candidate "
+              f"{_mib(mem.get('spill_candidate_bytes'))} "
+              f"({len(mem.get('spill_candidates', []))} object(s), "
+              f"idle>={mem.get('cold_after_s')}s)")
+        by_node = {}
+        for r in mem.get("top_holders", []):
+            by_node.setdefault(r.get("node"), []).append(r)
+        for node, recs in by_node.items():
+            tops = ", ".join(
+                f"{r.get('key', '?')[:12]}[{r.get('subsystem')}]"
+                f"={_mib(r.get('nbytes'))}" for r in recs[:5])
+            print(f"  node {(node or '?')[:12]} top holders: {tops}")
     if args.verbose:
         print(json.dumps(report, indent=2, default=str))
+        print(json.dumps(mem, indent=2, default=str))
     if failed:
         raise SystemExit(f"doctor: {failed} check(s) failed")
     print("doctor: all checks passed")
@@ -331,9 +423,19 @@ def main():
 
     s = sub.add_parser("list")
     s.add_argument("what", choices=["nodes", "actors", "tasks", "jobs",
-                                    "edges", "pgs"])
+                                    "edges", "objects", "pgs"])
     s.add_argument("--address", required=True)
     s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("top", help="cluster resource hogs; `top mem` = "
+                       "attributed memory by subsystem/holder "
+                       "(observability/memory.py)")
+    s.add_argument("what", choices=["mem"])
+    s.add_argument("--address", required=True)
+    s.add_argument("--limit", type=int, default=20)
+    s.add_argument("--json", action="store_true",
+                   help="raw memory_report() JSON")
+    s.set_defaults(fn=cmd_top)
 
     s = sub.add_parser("doctor", help="cluster health triage: nodes, "
                        "beacons, drop counters (non-zero exit on failure)")

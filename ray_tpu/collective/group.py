@@ -31,6 +31,7 @@ import ray_tpu
 from ray_tpu.collective.errors import CollectiveError, CollectiveTimeoutError
 from ray_tpu.collective.topology import Topology
 from ray_tpu.observability import health as _health
+from ray_tpu.observability import memory as _memory
 from ray_tpu.observability.edges import record_transfer
 
 #: Sentinel dict key marking a server-side timeout reply.
@@ -346,10 +347,11 @@ class GroupContext:
             self.eager_threshold = int(cfg.collective_eager_threshold_bytes)
             zc = int(cfg.collective_zerocopy_threshold_bytes)
             self.zc_threshold = zc if zc > 0 else None
-        #: unacked zero-copy chunks this rank put(): key → (ref, nbytes).
-        #: The ref pins the store copy until the receiver's resolve ack —
-        #: explicit lifetime instead of racing the borrower handoff.
-        self._zc_inflight: Dict[str, Tuple[Any, int]] = {}
+        #: unacked zero-copy chunks this rank put(): key → (ref, nbytes,
+        #: waiter_rank). The ref pins the store copy until the receiver's
+        #: resolve ack — explicit lifetime instead of racing the
+        #: borrower handoff.
+        self._zc_inflight: Dict[str, Tuple[Any, int, int]] = {}
         self._zc_bytes = 0
         # Measured coordinator-funnel model (feeds the cost-based backend
         # auto-selector): RTT EWMA from small exchanges, effective funnel
@@ -476,11 +478,22 @@ class GroupContext:
                 entry = self._zc_inflight.pop(k[len(ACK_PREFIX):], None)
                 if entry is not None:
                     self._zc_bytes -= entry[1]
+                    _memory.tracker().unpin(entry[0].id, "await_ack")
             if (not block or self._zc_bytes <= ZC_WINDOW_BYTES
                     or time.monotonic() >= deadline):
                 return
 
-    def _stage_payload(self, key: str, payload, n: int, hops: int = 1):
+    def _tag_staged(self, ref, n: int, key: str, waiter_rank: int) -> None:
+        """Attribute a staged zero-copy chunk to the collective subsystem
+        and pin it with the ack it waits on — `cli blackbox` / `cli top
+        mem` then name exactly which ack a stuck pinned chunk is missing
+        (and which rank owes it)."""
+        mem = _memory.tracker()
+        mem.retag(ref.id, "collective", group=self.name, ack_key=key)
+        mem.pin(ref.id, "await_ack", ack_key=key, waiter_rank=waiter_rank)
+
+    def _stage_payload(self, key: str, payload, n: int, hops: int = 1,
+                       dst_rank: int = -1):
         """Pick the wire form for one payload: zero-copy envelope (ref
         into the object store) or the inline value itself.
 
@@ -495,10 +508,11 @@ class GroupContext:
         if self._zc_bytes > ZC_WINDOW_BYTES:
             self._reap_zc_acks(block=True)
         ref = ray_tpu.put(payload)
-        self._zc_inflight[key] = (ref, n)
+        self._zc_inflight[key] = (ref, n, dst_rank)
         self._zc_bytes += n
         self.stats.zc_sends += 1
         self.stats.zc_bytes_sent += n
+        self._tag_staged(ref, n, key, dst_rank)
         return {ZC_KEY: True, "ref": ref, "nbytes": n,
                 "owner": self.rank, "ack_key": key, "hops": hops}
 
@@ -514,7 +528,7 @@ class GroupContext:
         self.stats.sends += 1
         if self.topology.node_of(dst_rank) != self.topology.node_of(self.rank):
             self.stats.bytes_sent_inter += n
-        value = self._stage_payload(key, payload, n)
+        value = self._stage_payload(key, payload, n, dst_rank=dst_rank)
         # a lost put surfaces as the receiver's timeout + peer probe
         # raylint: disable=leaked-object-ref -- fire-and-forget by design
         self.mailboxes[dst_rank].put.remote(key, value)
@@ -551,10 +565,11 @@ class GroupContext:
             else:
                 refs = [ray_tpu.put(p) for _, p, _ in zc_wave]
             for (key, _, n), ref in zip(zc_wave, refs):
-                self._zc_inflight[key] = (ref, n)
+                self._zc_inflight[key] = (ref, n, dst_rank)
                 self._zc_bytes += n
                 self.stats.zc_sends += 1
                 self.stats.zc_bytes_sent += n
+                self._tag_staged(ref, n, key, dst_rank)
                 entries[key] = {ZC_KEY: True, "ref": ref, "nbytes": n,
                                 "owner": self.rank, "ack_key": key,
                                 "hops": hops}
@@ -694,9 +709,17 @@ class GroupContext:
         try:
             from ray_tpu import _rt
             rt = _rt.get_runtime()
+            # Staged zero-copy chunks still pinned awaiting an ack: the
+            # dump names WHICH ack each stuck chunk waits on and which
+            # rank owes it — the usual culprit in a wedged ring.
+            staged = [{"ack_key": k, "nbytes": e[1],
+                       "waiter_rank": e[2] if len(e) > 2 else None,
+                       "object": e[0].id.hex()[:16]}
+                      for k, e in list(self._zc_inflight.items())[:64]]
             rt.flight.dump(reason, extra=dict(
                 extra, group=self.name, rank=self.rank, world=self.world,
-                seq=self.seq))
+                seq=self.seq, staged_unacked=staged,
+                staged_unacked_bytes=self._zc_bytes))
         except Exception:
             pass
 
@@ -734,6 +757,9 @@ class GroupContext:
 
     def destroy(self):
         """Kill every helper actor this rank can name (idempotent)."""
+        mem = _memory.tracker()
+        for ref, _, _ in self._zc_inflight.values():
+            mem.unpin(ref.id, "await_ack")
         self._zc_inflight.clear()
         self._zc_bytes = 0
         _health.drop_beacon(self._beacon.component)

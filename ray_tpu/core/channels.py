@@ -44,6 +44,18 @@ from typing import Any, Dict, Tuple
 from ray_tpu.core import serialization
 from ray_tpu.core.status import ActorDiedError, RayTpuError
 
+_memory_mod = None
+
+
+def _memattr():
+    """Lazy memory-attribution tracker (observability imports core at
+    module top, so core modules must import it on first use)."""
+    global _memory_mod
+    if _memory_mod is None:
+        from ray_tpu.observability import memory
+        _memory_mod = memory.tracker()
+    return _memory_mod
+
 logger = logging.getLogger("ray_tpu.channels")
 
 # Standing-channel instruments, created on first channel_open (lazy so
@@ -135,7 +147,7 @@ class _Channel:
     """Worker-side state of one standing channel."""
 
     __slots__ = ("spec", "args_template", "kwargs_template", "frames",
-                 "next_seq", "dispatched")
+                 "next_seq", "dispatched", "buffered_bytes", "mem_tracked")
 
     def __init__(self, spec: ChannelSpec):
         self.spec = spec
@@ -146,6 +158,8 @@ class _Channel:
         self.frames: Dict[int, Dict[int, Tuple[str, bytes]]] = {}
         self.next_seq = 0
         self.dispatched = 0
+        self.buffered_bytes = 0     # payload bytes parked in `frames`
+        self.mem_tracked = False    # synthetic record currently registered
 
     @staticmethod
     def _prep(entry: Tuple) -> Tuple:
@@ -203,7 +217,10 @@ class ChannelHost:
         return {"ok": True}
 
     async def rpc_channel_close(self, channel_id: str) -> dict:
-        self._channels.pop(channel_id, None)
+        ch = self._channels.pop(channel_id, None)
+        if ch is not None and ch.mem_tracked:
+            _memattr().release("channel:" +
+                               (ch.spec.label or ch.spec.channel_id[:8]))
         return {"ok": True}
 
     # --------------------------------------------------------------- delivery
@@ -213,7 +230,10 @@ class ChannelHost:
         """Runs on the event loop (RPC handler or local fast path)."""
         if seq < ch.next_seq:
             return   # stale duplicate of an already-dispatched seq
-        ch.frames.setdefault(seq, {})[slot] = (kind, payload)
+        frames = ch.frames.setdefault(seq, {})
+        prev = frames.get(slot)
+        frames[slot] = (kind, payload)
+        ch.buffered_bytes += len(payload) - (len(prev[1]) if prev else 0)
         fl = getattr(self.runtime, "flight", None)
         if fl is not None:
             fl.record({"kind": "channel_frame", "ts": time.time(),
@@ -225,12 +245,14 @@ class ChannelHost:
         while ch.frames.get(ch.next_seq) is not None \
                 and len(ch.frames[ch.next_seq]) >= ch.spec.n_slots:
             slots = ch.frames.pop(ch.next_seq)
+            ch.buffered_bytes -= sum(len(p) for _, p in slots.values())
             seq_now = ch.next_seq
             ch.next_seq += 1
             ch.dispatched += 1
             self._dispatch(ch, seq_now, slots)
         self._beacon.tick()
         label = ch.spec.label or ch.spec.channel_id[:8]
+        self._track_buffer(ch, label)
         depth_g, seq_g, _hop = self._gauges
         depth_g.set(float(len(ch.frames)), {"channel": label})
         seq_g.set(float(ch.next_seq), {"channel": label})
@@ -240,6 +262,23 @@ class ChannelHost:
         elif self._beacon.busy \
                 and not any(c.frames for c in self._channels.values()):
             self._beacon.disarm()
+
+    def _track_buffer(self, ch: _Channel, label: str) -> None:
+        """Mirror this channel's parked reorder bytes into the memory
+        plane as a synthetic (non-store) record, pinned with the seq the
+        gather is stuck behind while frames are parked — `top mem` then
+        shows a wedged compiled graph as channel bytes waiting on a seq."""
+        mem = _memattr()
+        key = "channel:" + label
+        if ch.buffered_bytes > 0:
+            mem.attribute(key, "channel", ch.buffered_bytes, store=False,
+                          waiting_seq=ch.next_seq, buffered=len(ch.frames))
+            if not ch.mem_tracked:
+                mem.pin(key, "reorder")
+                ch.mem_tracked = True
+        elif ch.mem_tracked:
+            mem.release(key)
+            ch.mem_tracked = False
 
     def _dispatch(self, ch: _Channel, seq: int,
                   slots: Dict[int, Tuple[str, bytes]]) -> None:

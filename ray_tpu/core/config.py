@@ -178,6 +178,17 @@ class Config:
     # rank) once it passes without progress — typically long before the
     # collective's own timeout would fire.
     collective_stall_deadline_s: float = 10.0
+    # --- memory attribution plane (observability/memory.py) -----------------
+    # Per-object ownership/pin/temperature records riding the batched
+    # telemetry report; False strips the put/get hot-path hooks to bare
+    # dict probes (bench.py --bench memory measures the difference).
+    memory_attribution: bool = True
+    # A record still pinned this long after its last owner ref died is a
+    # leak suspect in memory_report() (ref: `ray memory` leak triage).
+    memory_leak_suspect_s: float = 60.0
+    # An unpinned non-primary record idle this long is a spill candidate
+    # (the eviction shortlist the spilling pass will consume).
+    memory_cold_after_s: float = 30.0
     log_to_driver: bool = True
 
     def override(self, d: Dict[str, Any]) -> "Config":

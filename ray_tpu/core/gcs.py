@@ -105,6 +105,13 @@ class GcsServer:
         self.health = HealthAggregator(
             straggler_k=cfg.straggler_k,
             straggler_min_peers=cfg.straggler_min_peers)
+        # memory attribution fold over per-process tracker snapshots
+        # riding the same reports (in-memory, like health/edge_model)
+        from ray_tpu.observability.memory import MemoryAggregator
+        self.memory = MemoryAggregator(
+            leak_suspect_s=cfg.memory_leak_suspect_s,
+            cold_after_s=cfg.memory_cold_after_s,
+            stale_after_s=max(60.0, 10 * cfg.telemetry_report_interval_s))
         self.pool = ClientPool()
         self.server = RpcServer(self)
         # pluggable node-picking policies (ref: scheduling/policy/)
@@ -189,6 +196,8 @@ class GcsServer:
         # ...and its beacons: node death is already attributed; those
         # loops must not also fire as anonymous stalls
         self.health.forget_node(node_id.hex())
+        # ...and its memory attribution: the store died with the node
+        self.memory.forget_node(node_id.hex())
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         await self._publish("node", {"node_id": node_id, "alive": False})
         # Restart actors that lived there (ref: gcs_actor_manager.cc:1100).
@@ -701,6 +710,10 @@ class GcsServer:
             stalled = self.health.update(str(report.get("worker", "?")),
                                          report.get("node"), beacons)
             self._drain_health_events()
+        mem = report.get("memory")
+        if mem:
+            self.memory.update(str(report.get("worker", "?")),
+                               report.get("node"), mem)
         for ob in report.get("edges") or []:
             self.edge_model.observe(ob.get("src"), ob.get("dst"),
                                     ob.get("nbytes", 0.0),
@@ -765,6 +778,29 @@ class GcsServer:
         rep["nodes_alive"] = sum(1 for n in self.nodes.values() if n.alive)
         rep["nodes_dead"] = sum(1 for n in self.nodes.values() if not n.alive)
         return rep
+
+    async def rpc_memory_report(self, top_n: int = 20) -> dict:
+        """Cluster memory attribution view (observability/memory.py):
+        worker tracker snapshots folded by the aggregator, joined with
+        the per-node store occupancy the nodelet agents push to KV
+        ns="node_stats" — which also carries each nodelet's own tracker
+        payload (primary-pin records), folded here on read."""
+        import json as _json
+
+        node_stats: Dict[str, dict] = {}
+        for (ns, key) in list(self.kv):
+            if ns != "node_stats":
+                continue
+            try:
+                st = _json.loads(self.kv[(ns, key)])
+            except Exception:
+                continue
+            node_hex = key.hex()
+            node_stats[node_hex] = st
+            mem = st.get("memory")
+            if mem:
+                self.memory.update(f"nodelet:{node_hex[:12]}", node_hex, mem)
+        return self.memory.report(node_stats, top_n=top_n)
 
     async def rpc_edge_stats(self) -> Dict[str, dict]:
         return self.edge_model.stats()

@@ -44,6 +44,18 @@ from ray_tpu.core.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
 
 logger = logging.getLogger("ray_tpu.nodelet")
 
+_memory_mod = None
+
+
+def _memattr():
+    """Lazy memory-attribution tracker (observability imports core at
+    module top, so core modules must import it on first use)."""
+    global _memory_mod
+    if _memory_mod is None:
+        from ray_tpu.observability import memory
+        _memory_mod = memory.tracker()
+    return _memory_mod
+
 
 class WorkerRecord:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen,
@@ -944,6 +956,7 @@ class Nodelet:
             our_pin = 1 if oid in self.primary_pins else 0
             if self.store.evict_if_unpinned(oid, max_pins=our_pin):
                 self.primary_pins.discard(oid)
+                _memattr().release(oid)   # left shm; the spill tier holds it
                 self._spilled_then_dropped += 1
                 freed += size
         return {"freed": freed}
@@ -966,8 +979,12 @@ class Nodelet:
             # Already only on disk (or gone); the spill tier is the pin.
             ok = self.spill is not None and self.spill.contains(oid)
             return {"ok": ok}
+        size = len(view)
         del view  # keep the refcount from ts_get; release happens at unpin
         self.primary_pins.add(oid)
+        mem = _memattr()
+        mem.attribute(oid, "user", size, owner=self.node_id.hex()[:12])
+        mem.pin(oid, "primary")
         return {"ok": True}
 
     async def rpc_pin_objects(self, oids: List[ObjectID]) -> dict:
@@ -1177,6 +1194,7 @@ class Nodelet:
                 self.store.release(oid)
                 self.primary_pins.discard(oid)
             self.store.delete(oid)
+            _memattr().release(oid)
             if self.spill is not None:
                 self.spill.delete(oid)
         return {"ok": True}
@@ -1213,7 +1231,18 @@ class Nodelet:
             "xfer_port": self.xfer_port,
             "pending_leases": len(self.pending),
             "oom_kills": self.memory_monitor.kills,
+            # Memory-attribution snapshot rides the node_stats KV push
+            # (the nodelet has no TelemetryAgent); the GCS folds it at
+            # memory_report() read time.
+            "memory": self._memory_snapshot(),
         }
+
+    def _memory_snapshot(self):
+        try:
+            from ray_tpu.observability import memory as _mem
+            return _mem.snapshot_for_report(self.store)
+        except Exception:
+            return None
 
     async def rpc_ping(self) -> dict:
         return {"ok": True}

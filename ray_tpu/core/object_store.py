@@ -29,6 +29,19 @@ from ray_tpu.core import serialization
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.status import ObjectStoreFullError
 
+_memory_mod = None
+
+
+def _memattr():
+    """Cached import of the attribution tracker: observability.memory is
+    stdlib-only, but its package __init__ pulls util.metrics -> runtime,
+    which must not load while THIS module is mid-import (cycle)."""
+    global _memory_mod
+    if _memory_mod is None:
+        from ray_tpu.observability import memory
+        _memory_mod = memory.tracker()
+    return _memory_mod
+
 
 class _Lib:
     _lib = None
@@ -172,6 +185,7 @@ class SharedMemoryStore:
         off = self._lib.ts_get(self._h, oid.binary(), ctypes.byref(size))
         if off == 0:
             return None
+        _memattr().touch(oid)   # temperature: every pin is an access
         return self._view[off:off + size.value]
 
     def release(self, oid: ObjectID) -> None:

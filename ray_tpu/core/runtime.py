@@ -246,6 +246,19 @@ class _ExecCtxVar:
         self._dict()[name] = value
 
 
+_MEMTRACK = None
+
+
+def _memtrack():
+    """Cached attribution tracker (observability/memory.py). Lazy for the
+    same import-cycle reason as the TelemetryAgent import in __init__."""
+    global _MEMTRACK
+    if _MEMTRACK is None:
+        from ray_tpu.observability import memory
+        _MEMTRACK = memory.tracker()
+    return _MEMTRACK
+
+
 class _ReadPin:
     """Holds one store read pin for exactly as long as any zero-copy value
     derived from the object's bytes is alive. Deserialized arrays export
@@ -291,6 +304,7 @@ class _ReadPin:
         self._view = None
         try:
             self._store.release(self._oid)
+            _memtrack().unpin(self._oid, "read")
         except Exception:
             pass   # interpreter/store teardown
 
@@ -439,6 +453,18 @@ class Runtime:
         # (observability/flight.py; rendered by `cli blackbox`).
         from ray_tpu.observability.flight import FlightRecorder
         self.flight = FlightRecorder(self)
+        # Memory attribution plane (observability/memory.py): per-object
+        # ownership/pin/temperature records; snapshots ride the telemetry
+        # report above. Same lazy-import rule as the agent.
+        from ray_tpu.observability import memory as _memory
+        _memory.set_enabled(bool(cfg.memory_attribution))
+        self._memattr = _memory.tracker()
+        self._worker_hex = self.worker_id.hex()[:12]
+        if cfg.memory_attribution:
+            # the reporter otherwise starts on the first task event — a
+            # process that only put/get's would never ship its read-pin
+            # and orphan records (empty reports are still skipped)
+            self.telemetry.ensure_started()
         # compiled-DAG output sinks by id: channel_result frames from the
         # leaf workers land here (core/channels.py, dag/compiled.py)
         self._channel_sinks: Dict[str, Any] = {}
@@ -651,6 +677,7 @@ class Runtime:
                 serialization.write_to(view, meta, bufs)
                 del view
                 self.store.seal(oid)
+            self._attribute_put(oid, size)
             if _pin:
                 self._pin_primary(oid)
             e.locations.add(self.nodelet_addr)
@@ -659,6 +686,15 @@ class Runtime:
         e.state = "ready"
         self._complete_entry(e)
         return ObjectRef(oid, self.address)
+
+    def _attribute_put(self, oid: ObjectID, size: int):
+        """Attribution record for a store-resident object this process
+        just wrote: default holder "user" (subsystems retag their own),
+        owner worker + creating task for the memory_report() lineage."""
+        tid = getattr(self._exec_ctx, "task_id", None)
+        self._memattr.attribute(
+            oid, "user", size, owner=self._worker_hex,
+            task=tid.hex()[:16] if hasattr(tid, "hex") else None)
 
     def put_batch(self, values: Sequence[Any]) -> List[ObjectRef]:
         """Batched put(): serialize every value into the store first, then
@@ -699,6 +735,7 @@ class Runtime:
                         serialization.write_to(view, meta, bufs)
                         del view
                         self.store.seal(oid)
+                    self._attribute_put(oid, size)
                     pend.append((oid, self.store.get_view(oid)))
                     e.locations.add(self.nodelet_addr)
                     e.primaries.add(self.nodelet_addr)
@@ -711,6 +748,8 @@ class Runtime:
                     self._run(self.pool.get(self.nodelet_addr).call(
                         "pin_objects", oids=[oid for oid, _ in pend],
                         timeout=60.0))
+                    for oid, _ in pend:
+                        self._memattr.pin(oid, "primary")
                 except (ConnectionLost, RemoteError, OSError) as err:
                     logger.warning("pin_objects(%d) failed: %s",
                                    len(pend), err)
@@ -729,6 +768,7 @@ class Runtime:
         try:
             self._run(self.pool.get(self.nodelet_addr).call(
                 "pin_object", oid=oid, timeout=30.0))
+            self._memattr.pin(oid, "primary")
         except (ConnectionLost, RemoteError, OSError) as e:
             logger.warning("pin_object(%s) failed: %s", oid.hex()[:12], e)
         finally:
@@ -802,6 +842,7 @@ class Runtime:
                     serialization.write_to(view, meta, bufs)
                     del view
                     self.store.seal(oid)
+                self._attribute_put(oid, size)
                 self._pin_primary(oid)
                 with self._dir_lock:
                     e.locations.add(self.nodelet_addr)
@@ -853,6 +894,9 @@ class Runtime:
         caches only (the owner's copy is none of our business)."""
         self.memory_store.delete(oid)
         self._pinned.pop(oid, None)
+        # attribution: our local record dies with the borrow (a live
+        # _ReadPin keeps it visible as an orphan — the leak signature)
+        self._memattr.owner_ref_dead(oid)
 
     def _free_object(self, oid: ObjectID):
         """All refs gone: drop every copy (ref: ReferenceCounter on-zero →
@@ -862,6 +906,11 @@ class Runtime:
         # NOT store.release here: live zero-copy values hold their own
         # pin via _ReadPin and release when the last one dies
         self._pinned.pop(oid, None)
+        # the delete below drops the nodelet's primary pin; a record that
+        # keeps OTHER pins past this point (a still-alive zero-copy view,
+        # an unacked collective chunk) becomes a leak-suspect orphan
+        self._memattr.unpin(oid, "primary")
+        self._memattr.owner_ref_dead(oid)
         with self._dir_lock:
             e = self.directory.pop(oid, None)
         if e is not None and e._locations:
@@ -1019,6 +1068,14 @@ class Runtime:
                 pin, lambda r, oid=oid: (
                     self._pinned.pop(oid, None)
                     if self._pinned.get(oid) is r else None))
+            # attribute-if-missing covers copies this process did not
+            # write (borrowed pulls landed by the nodelet); then count
+            # the zero-copy reader against the record
+            self._memattr.attribute(oid, "user", len(view),
+                                    owner=self._worker_hex, copy="read")
+            self._memattr.pin(oid, "read")
+        else:
+            self._memattr.touch(oid)   # temperature on the pinned fast path
         # values deserialize out of the pin's buffer: their buffer chains
         # keep the pin (and thus the store region) alive
         value = serialization.read_from(pin.buffer())

@@ -209,6 +209,7 @@ class Worker:
             serialization.write_to(view, meta, bufs)
             del view
             store.seal(rid)
+            self.runtime._attribute_put(rid, size)
             self.runtime._pin_primary(rid)  # nodelet owns the pin
         elif not store.contains(rid):
             raise MemoryError(

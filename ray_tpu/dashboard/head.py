@@ -214,6 +214,12 @@ class DashboardHead:
         straggler events, drop counters (observability/health.py)."""
         return self._json(await self._gcs("health_report"))
 
+    async def _h_memory(self, request):
+        """Memory plane: per-subsystem attribution, top holders, spill
+        candidates, leak suspects (observability/memory.py)."""
+        top_n = int(request.query.get("top_n", 20))
+        return self._json(await self._gcs("memory_report", top_n=top_n))
+
     async def _h_tasks(self, request):
         limit = int(request.query.get("limit", 1000))
         return self._json(await self._gcs("list_task_events", limit=limit))
@@ -536,6 +542,7 @@ class DashboardHead:
         app.router.add_get("/api/v0/node_stats", self._h_node_stats)
         app.router.add_get("/api/v0/edge_stats", self._h_edge_stats)
         app.router.add_get("/api/v0/health", self._h_health)
+        app.router.add_get("/api/v0/memory", self._h_memory)
         app.router.add_get("/metrics", self._h_metrics)
         app.router.add_get("/api/v0/logs", self._h_logs)
         self._runner = web.AppRunner(app)

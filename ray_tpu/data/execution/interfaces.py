@@ -21,6 +21,18 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional
 
+_memory_mod = None
+
+
+def _memattr():
+    """Lazy memory-attribution tracker (observability imports core at
+    module top, so execution modules must import it on first use)."""
+    global _memory_mod
+    if _memory_mod is None:
+        from ray_tpu.observability import memory
+        _memory_mod = memory.tracker()
+    return _memory_mod
+
 
 @dataclass
 class BlockMeta:
@@ -60,10 +72,19 @@ class OpBuffer:
     def append(self, bundle: RefBundle) -> None:
         self._q.append(bundle)
         self._nbytes += bundle.nbytes
+        # Queued blocks belong to the data plane: retag the (possibly
+        # worker-produced) block so memory_report() attributes it to
+        # "data" instead of the generic "user" bucket.
+        oid = getattr(bundle.block_ref, "id", None)
+        if oid is not None:
+            _memattr().retag(oid, "data")
 
     def popleft(self) -> RefBundle:
         bundle = self._q.popleft()
         self._nbytes -= bundle.nbytes
+        oid = getattr(bundle.block_ref, "id", None)
+        if oid is not None:
+            _memattr().touch(oid)   # consumption is an access
         return bundle
 
     @property

@@ -24,7 +24,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-from ray_tpu.data.execution.interfaces import PhysicalOperator
+from ray_tpu.data.execution.interfaces import PhysicalOperator, _memattr
 
 #: fallback per-task output estimate before any sizes are known
 _DEFAULT_OUTPUT_EST = 1 << 20
@@ -91,6 +91,21 @@ class ResourceManager:
         return (self.outqueue_usage(op) + self.est_output_bytes(op)
                 <= self.per_op_budget)
 
+    def _track_queued(self, ops: List[PhysicalOperator]) -> None:
+        """Mirror the pipeline's total unconsumed output bytes into the
+        memory plane (synthetic aggregate; per-block records are retagged
+        "data" by OpBuffer.append). Runs once per scheduling decision."""
+        total = sum(op.queued_output_bytes() for op in ops)
+        mem = _memattr()
+        key = "data:outqueues"
+        if total > 0:
+            mem.attribute(key, "data", total, store=False,
+                          budget=self.per_op_budget)
+            self._tracked = True
+        elif getattr(self, "_tracked", False):
+            mem.release(key)
+            self._tracked = False
+
     # --- policy --------------------------------------------------------------
 
     def select_operator_to_run(
@@ -102,6 +117,7 @@ class ResourceManager:
         now = time.monotonic()
         dt = (now - self._last_select_t) if self._last_select_t else 0.0
         self._last_select_t = now
+        self._track_queued(ops)
 
         candidates = [op for op in ops if op.can_submit()]
         eligible = []

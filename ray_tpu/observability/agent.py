@@ -27,6 +27,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu.observability import health as _health
+from ray_tpu.observability import memory as _memory
 from ray_tpu.util import metrics as _metrics
 
 # Edge observations are tiny and summarized GCS-side; a modest bound.
@@ -88,6 +89,12 @@ class TelemetryAgent:
         return float(getattr(self._rt.cfg, "telemetry_report_interval_s", 1.0))
 
     # --------------------------------------------------------- reporter thread
+
+    def ensure_started(self) -> None:
+        """Start the reporter without waiting for a first event — memory
+        attribution needs a shipping cadence even in processes that never
+        record a task event (put/get-only drivers)."""
+        self._ensure_thread()
 
     def _ensure_thread(self) -> None:
         if self._thread is not None or self._stopped.is_set():
@@ -163,14 +170,25 @@ class TelemetryAgent:
             # fresh age even when nothing else happened — that is
             # exactly the silent-stall case.
             beacons = _health.snapshot_beacons()
+            # Memory attribution rides the same report (no new RPC
+            # cadence): per-object ownership/pin/temperature records,
+            # validated against the local store so stale ones prune.
+            try:
+                mem = _memory.snapshot_for_report(
+                    getattr(self._rt, "store", None))
+                _memory.publish_gauges()
+            except Exception:
+                mem = None
             if not (events or edges or metric_deltas or self_deltas
-                    or beacons):
+                    or beacons or mem):
                 return True
             report = {"events": events, "edges": edges,
                       "metrics": metric_deltas + self_deltas,
                       "beacons": beacons,
                       "worker": self._rt.worker_id.hex()[:12],
                       "node": getattr(self._rt, "node_id", None)}
+            if mem:
+                report["memory"] = mem
             try:
                 reply = self._rt.gcs_call("telemetry_report", report=report,
                                           rpc_timeout=10.0)

@@ -153,7 +153,21 @@ class LLMEngine:
             num_pages = num_pages or max_slots * maxP + 1
             self.kp, self.vp = llama.init_paged_cache(cfg, num_pages,
                                                       page_size)
-            self.pool = PagePool(num_pages, page_size, max_slots, maxP)
+
+            def _nb(x):
+                try:
+                    return int(x.nbytes)
+                except Exception:
+                    try:
+                        return sum(int(a.nbytes) for a in x)
+                    except Exception:
+                        return 0
+
+            # per-page device bytes (K+V across layers) so the pool can
+            # report occupied-page bytes to the memory plane
+            page_nbytes = (_nb(self.kp) + _nb(self.vp)) // num_pages
+            self.pool = PagePool(num_pages, page_size, max_slots, maxP,
+                                 page_nbytes=page_nbytes)
             # automatic prefix caching (ref: vLLM APC): share full
             # prompt pages by content hash; a hit skips that prefix's
             # prefill compute AND its page memory, and ONE chunked

@@ -30,6 +30,17 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+_memory_mod = None
+
+
+def _memattr():
+    """Lazy memory-attribution tracker (keeps this module import-light)."""
+    global _memory_mod
+    if _memory_mod is None:
+        from ray_tpu.observability import memory
+        _memory_mod = memory.tracker()
+    return _memory_mod
+
 
 def page_chain_hashes(tokens, page_size: int) -> List[bytes]:
     """Chain hash per FULL page of `tokens`: h_i = H(h_{i-1} || page_i).
@@ -47,11 +58,17 @@ def page_chain_hashes(tokens, page_size: int) -> List[bytes]:
 
 class PagePool:
     def __init__(self, num_pages: int, page_size: int, max_slots: int,
-                 max_pages_per_slot: int):
+                 max_pages_per_slot: int, page_nbytes: int = 0):
         assert num_pages >= 2, "need at least one real page beyond trash"
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_slot = max_pages_per_slot
+        # device bytes per physical page (K+V across layers); when the
+        # engine provides it, occupied pages register with the memory
+        # plane as a synthetic "kv" record (see _track_mem)
+        self.page_nbytes = int(page_nbytes)
+        self._mem_key = f"kvpool:{id(self):x}"
+        self._mem_tracked = False
         # LIFO free list; page 0 reserved as trash
         self.free: List[int] = list(range(num_pages - 1, 0, -1))
         self.table = np.zeros((max_slots, max_pages_per_slot), np.int32)
@@ -88,6 +105,22 @@ class PagePool:
     def can_fit(self, tokens: int) -> bool:
         return self.pages_for(tokens) <= self.available_pages
 
+    def _track_mem(self) -> None:
+        """Mirror occupied-page bytes (incl. evictable cached pages —
+        they still hold device memory) into the memory plane."""
+        if not self.page_nbytes:
+            return
+        held = self.used_pages   # includes parked evictable pages
+        mem = _memattr()
+        if held > 0:
+            mem.attribute(self._mem_key, "kv", held * self.page_nbytes,
+                          store=False, pages=held,
+                          evictable=len(self.evictable))
+            self._mem_tracked = True
+        elif self._mem_tracked:
+            mem.release(self._mem_key)
+            self._mem_tracked = False
+
     def _unregister(self, page: int) -> None:
         h = self.page_to_hash.pop(page, None)
         if h is not None and self.hash_to_page.get(h) == page:
@@ -122,6 +155,7 @@ class PagePool:
             self.owned[slot].append(p)
             self.ref[p] = 1
         self.table_version += 1
+        self._track_mem()
         return True
 
     def release(self, slot: int) -> None:
@@ -137,6 +171,7 @@ class PagePool:
         self.owned[slot] = []
         self.table[slot] = 0
         self.table_version += 1
+        self._track_mem()
 
     # ---- prefix cache ------------------------------------------------------
 
@@ -160,6 +195,7 @@ class PagePool:
             self.ref[p] += 1
             self.evictable.pop(p, None)     # in use again
         self.table_version += 1
+        self._track_mem()
         if len(self.owned[slot]) > self.max_pages_per_slot:
             raise ValueError("adopted prefix exceeds max_pages_per_slot")
 

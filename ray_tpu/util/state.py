@@ -126,6 +126,22 @@ def _metric_total(name: str) -> float:
         return 0.0
 
 
+def memory_report(top_n: int = 20) -> dict:
+    """Cluster memory attribution (observability/memory.py): per-
+    subsystem bytes, top holders with owner/pins/temperature, per-node
+    store coverage, the spill-candidate list (unpinned AND cold) and
+    leak suspects (pinned with no live owner ref past
+    `memory_leak_suspect_s`)."""
+    return rt.get_runtime().gcs_call("memory_report", top_n=top_n)
+
+
+def list_objects(limit: int = 100) -> List[dict]:
+    """Attributed resident objects, largest first (ref: `ray memory`'s
+    object table) — from the same aggregated view as memory_report()."""
+    rep = memory_report(top_n=limit)
+    return rep.get("top_holders", [])
+
+
 def memory_summary() -> dict:
     """Owner-side refcount stats (ref: `ray memory` scripts.py:1900)
     plus spilling-readiness gauges: local store occupancy / pinned bytes

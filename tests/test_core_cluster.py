@@ -154,9 +154,26 @@ def test_lineage_reconstruction(ray_start_cluster):
     # "volatile", which died with the node, so reconstruction must surface
     # ObjectLostError... unless we give it somewhere to go:
     cluster.add_node(resources={"CPU": 2.0, "volatile": 1.0})
-    time.sleep(1.0)
+
+    def _alive_nodes():
+        from ray_tpu.util import state
+
+        return sum(1 for n in state.list_nodes() if n["alive"])
+
+    def _wait(pred, timeout=20.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.1)
+        return False
+
+    # the replacement node must be REGISTERED before the volatile one dies,
+    # or reconstruction has nowhere to go (fixed sleeps here were flaky
+    # under load)
+    assert _wait(lambda: _alive_nodes() == 3), "replacement never registered"
     cluster.remove_node(volatile)
-    time.sleep(2.0)
+    assert _wait(lambda: _alive_nodes() == 2), "node death never detected"
     out = ray_tpu.get(ref, timeout=60)
     assert float(out.sum()) == 300_000.0
 
