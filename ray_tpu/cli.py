@@ -371,6 +371,17 @@ def cmd_serve(args):
         print("serve shut down")
 
 
+def cmd_lint(args):
+    """raylint over the tree (no cluster needed). All flags pass through
+    to the lint CLI: `ray_tpu lint --changed-only --fail-on error ...`"""
+    from ray_tpu.devtools.lint.cli import main as lint_main
+
+    rest = args.lint_args
+    if rest[:1] == ["--"]:   # `ray_tpu lint -- --flags` form
+        rest = rest[1:]
+    sys.exit(lint_main(rest))
+
+
 def cmd_dashboard(args):
     """Serve the HTTP dashboard against a running cluster
     (ref: dashboard/head.py)."""
@@ -393,6 +404,16 @@ def cmd_dashboard(args):
 
 
 def main():
+    # `lint` routes before argparse: REMAINDER refuses leading optionals
+    # (bpo-17050), and every lint arg is a passthrough anyway.
+    if sys.argv[1:2] == ["lint"]:
+
+        class _A:
+            lint_args = sys.argv[2:]
+
+        cmd_lint(_A())
+        return
+
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -487,6 +508,13 @@ def main():
     s.add_argument("--address", required=True)
     s.add_argument("--config", default=None, help="config file for deploy")
     s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("lint", help="raylint static analysis "
+                       "(`ray_tpu lint -- --help` for its flags)")
+    s.add_argument("lint_args", nargs=argparse.REMAINDER,
+                   help="passed through to python -m ray_tpu.devtools.lint "
+                        "(paths, --changed-only, --fail-on, --json, ...)")
+    s.set_defaults(fn=cmd_lint)
 
     # cluster launcher (ref: scripts.py:1238,1314,1398,1696 up/down/
     # attach/exec over the NodeProvider API)

@@ -1,7 +1,13 @@
 """raylint command line: ``python -m ray_tpu.devtools.lint [paths]``.
 
-Exit code 0 when every finding is suppressed (or there are none),
-1 when unsuppressed findings remain, 2 on usage errors.
+Exit code 0 when no finding clears the ``--fail-on`` threshold (all
+suppressed, or warn-only findings under ``--fail-on error``), 1 when
+failing findings remain, 2 on usage errors.
+
+Results are cached under ``.raylint_cache/`` keyed by (file content
+sha, ruleset fingerprint); a warm run over an unchanged tree skips
+parsing and per-file analysis entirely. ``--no-cache`` disables it,
+``--cache-dir`` relocates it.
 """
 
 from __future__ import annotations
@@ -11,7 +17,7 @@ import json
 import sys
 from typing import List, Optional
 
-from ray_tpu.devtools.lint.engine import run_lint
+from ray_tpu.devtools.lint.engine import DEFAULT_CACHE_DIR, run_lint
 from ray_tpu.devtools.lint.registry import all_rules
 
 
@@ -25,7 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "(default: ray_tpu)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the machine-readable report (stable "
-                             "schema, version 1) instead of text")
+                             "schema, version 2) instead of text")
     parser.add_argument("--changed-only", action="store_true",
                         help="limit to files changed vs git HEAD plus "
                              "untracked files (fast pre-commit mode); "
@@ -33,6 +39,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--rule", action="append", default=None,
                         metavar="RULE-ID",
                         help="run only this rule (repeatable)")
+    parser.add_argument("--fail-on", choices=("error", "warn"),
+                        default="warn",
+                        help="minimum severity that fails the run "
+                             "(default: warn — any unsuppressed finding)")
+    parser.add_argument("--cache-dir", default=DEFAULT_CACHE_DIR,
+                        metavar="DIR",
+                        help="result cache location "
+                             f"(default: {DEFAULT_CACHE_DIR})")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="analyze every file from scratch")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print suppressed findings in text mode")
     parser.add_argument("--list-rules", action="store_true",
@@ -45,7 +61,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     rules = all_rules()
     if args.list_rules:
         for r in rules:
-            print(f"{r.id:24s} {r.doc}")
+            print(f"{r.id:24s} [{r.severity}] {r.doc}")
         return 0
     if args.rule:
         known = {r.id for r in rules}
@@ -56,7 +72,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         rules = [r for r in rules if r.id in set(args.rule)]
 
     report = run_lint(args.paths, rules=rules,
-                      changed_only=args.changed_only)
+                      changed_only=args.changed_only,
+                      cache_dir=None if args.no_cache else args.cache_dir)
 
     if args.as_json:
         print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
@@ -68,7 +85,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 continue
             print(f.render())
         print(report.summary_line())
-    return 1 if report.unsuppressed else 0
+    return 1 if report.failing(args.fail_on) else 0
 
 
 if __name__ == "__main__":

@@ -4,6 +4,9 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+SCHEMA_VERSION = 2
+SEVERITIES = ("error", "warn")
+
 
 @dataclass
 class Finding:
@@ -13,16 +16,18 @@ class Finding:
     col: int            # 0-based, ast convention
     message: str        # what is wrong at this site
     hint: str = ""      # how to fix it (one line)
+    severity: str = "error"   # "error" | "warn"
     suppressed: bool = field(default=False)
 
     def render(self) -> str:
         tail = f"  [hint: {self.hint}]" if self.hint else ""
         sup = "  (suppressed)" if self.suppressed else ""
-        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity}: {self.rule}: "
                 f"{self.message}{tail}{sup}")
 
     def to_dict(self) -> dict:
-        # Stable --json schema; tests/test_lint.py pins these keys.
+        # Stable --json schema v2; tests/test_lint.py pins these keys.
         return {
             "rule": self.rule,
             "path": self.path,
@@ -30,5 +35,17 @@ class Finding:
             "col": self.col,
             "message": self.message,
             "hint": self.hint,
+            "severity": self.severity,
             "suppressed": self.suppressed,
         }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "Finding":
+        """Accepts v1 dicts (no severity field — everything was an
+        error) and v2; tooling reading old CI artifacts keeps working."""
+        return cls(
+            rule=doc["rule"], path=doc["path"], line=doc["line"],
+            col=doc["col"], message=doc["message"],
+            hint=doc.get("hint", ""),
+            severity=doc.get("severity", "error"),
+            suppressed=doc.get("suppressed", False))

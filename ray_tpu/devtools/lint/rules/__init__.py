@@ -1,6 +1,9 @@
 """Rule modules. Importing this package registers every rule."""
 
-from ray_tpu.devtools.lint.rules import (blocking_async,  # noqa: F401
+from ray_tpu.devtools.lint.rules import (actor_get_cycle,  # noqa: F401
+                                         blocking_async,
+                                         channel_protocol,
                                          closure_capture, config_drift,
                                          divergent_collective, leaked_ref,
-                                         pep479)
+                                         locks, pep479,
+                                         useless_suppression)

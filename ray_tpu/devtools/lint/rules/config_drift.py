@@ -253,6 +253,60 @@ def _scope_filter(tree: ast.AST, typer: _FileTyper):
     typer.accesses = [a for a in typer.accesses if keep(a)]
 
 
+_TOKEN_ATTR = re.compile(r"\.(\w+)")
+_TOKEN_STR = re.compile(r"['\"](\w+)['\"]")
+_TOKEN_ENV = re.compile(r"RAY_TPU_(\w+)")
+
+
+def extract_config(tree: ast.AST, source: str, path: str) -> dict:
+    """The per-file half of the knob-drift analysis, JSON-able so the
+    engine can cache it (summaries.py calls this into FileSummary.config).
+    The cross-file aggregation lives in check_graph below."""
+    typer = _FileTyper(_ctx_producer_names(tree))
+    # two passes so use-before-def bindings (methods defined above
+    # __init__) still resolve
+    typer.visit(tree)
+    typer.accesses.clear()
+    typer.visit(tree)
+    _scope_filter(tree, typer)
+
+    classes = {
+        cls: {"fields": info["fields"],
+              "methods": sorted(info["methods"]), "line": info["line"]}
+        for cls, info in _class_fields(tree, path).items()}
+
+    # self.<attr> loads inside a config class defined here — the class
+    # mediates access for its callers (e.g. DataContext.resolve_policy)
+    self_reads: Dict[str, List[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in classes:
+            reads = {sub.attr for sub in ast.walk(node)
+                     if isinstance(sub, ast.Attribute)
+                     and isinstance(sub.ctx, ast.Load)
+                     and isinstance(sub.value, ast.Name)
+                     and sub.value.id == "self"}
+            if reads:
+                self_reads[node.name] = sorted(reads)
+
+    # knob-shaped tokens for the untyped-receiver/string-key/env-var
+    # fallback: `.knob`, "knob", RAY_TPU_KNOB (env tails keep their
+    # `_`-split prefixes so RAY_TPU_FOO_BAR still reads knob `foo`)
+    tokens = set(_TOKEN_ATTR.findall(source))
+    tokens.update(_TOKEN_STR.findall(source))
+    for env in _TOKEN_ENV.findall(source):
+        parts = env.lower().split("_")
+        for i in range(1, len(parts) + 1):
+            tokens.add("_".join(parts[:i]))
+
+    return {
+        "classes": classes,
+        "accesses": [[cls, node.attr, node.lineno, node.col_offset]
+                     for cls, node in typer.accesses],
+        "self_reads": self_reads,
+        "tokens": sorted(tokens),
+    }
+
+
 @register
 class ConfigKnobDrift(Rule):
     id = "config-knob-drift"
@@ -260,72 +314,52 @@ class ConfigKnobDrift(Rule):
            "or defined but never read anywhere in the scanned tree")
     hint = ("define the knob on the config class, or delete/wire the "
             "dead knob")
-    scope = "project"
+    scope = "graph"
 
-    def check_project(self, parsed_files):
+    def check_graph(self, graph):
         classes: Dict[str, dict] = {}
-        for pf in parsed_files:
-            for cls, info in _class_fields(pf.tree, pf.path).items():
+        for fs in graph.files:
+            for cls, info in fs.config.get("classes", {}).items():
                 if cls in classes:
                     # two definitions (e.g. fixtures): merge fields so
                     # neither side false-positives the other's knobs
                     classes[cls]["fields"].update(info["fields"])
-                    classes[cls]["methods"] |= info["methods"]
+                    classes[cls]["methods"] |= set(info["methods"])
                 else:
-                    classes[cls] = info
+                    classes[cls] = {"fields": dict(info["fields"]),
+                                    "methods": set(info["methods"]),
+                                    "path": fs.path, "line": info["line"]}
         if not classes:
             return
 
         read_fields: Dict[str, Set[str]] = {c: set() for c in classes}
         findings: List[Finding] = []
 
-        for pf in parsed_files:
-            typer = _FileTyper(_ctx_producer_names(pf.tree))
-            # two passes so use-before-def bindings (methods defined
-            # above __init__) still resolve
-            typer.visit(pf.tree)
-            typer.accesses.clear()
-            typer.visit(pf.tree)
-            _scope_filter(pf.tree, typer)
-            # self.<field> loads inside the config class's own methods
-            # count as consumption (the class mediates access for its
-            # callers, e.g. DataContext.resolve_policy)
-            for node in ast.walk(pf.tree):
-                if isinstance(node, ast.ClassDef) \
-                        and node.name in classes \
-                        and pf.path == classes[node.name]["path"]:
-                    for sub in ast.walk(node):
-                        if isinstance(sub, ast.Attribute) \
-                                and isinstance(sub.ctx, ast.Load) \
-                                and isinstance(sub.value, ast.Name) \
-                                and sub.value.id == "self" \
-                                and sub.attr in classes[node.name]["fields"]:
-                            read_fields[node.name].add(sub.attr)
-            for cls, node in typer.accesses:
+        for fs in graph.files:
+            cfg = fs.config
+            for cls, reads in cfg.get("self_reads", {}).items():
+                if cls in classes and fs.path == classes[cls]["path"]:
+                    read_fields[cls] |= \
+                        set(reads) & set(classes[cls]["fields"])
+            for cls, attr, line, col in cfg.get("accesses", []):
                 if cls not in classes:
                     continue
                 info = classes[cls]
-                attr = node.attr
                 if attr in info["fields"]:
                     read_fields[cls].add(attr)
                 elif attr not in info["methods"] \
                         and not attr.startswith("_"):
                     findings.append(Finding(
-                        rule=self.id, path=pf.path,
-                        line=node.lineno, col=node.col_offset,
+                        rule=self.id, path=fs.path, line=line, col=col,
                         message=f"{cls}.{attr} is read here but {cls} "
                                 "defines no such knob",
                         hint="add the field to the config class (typo?)"))
-            # attribute reads through untyped receivers + string keys
-            # still count as "something consumes this knob"
+            tokens = set(cfg.get("tokens", []))
             for cls, info in classes.items():
+                if fs.path == info["path"]:
+                    continue  # the defining file doesn't count
                 for f in info["fields"]:
-                    if f in read_fields[cls]:
-                        continue
-                    if pf.path == info["path"]:
-                        continue  # the defining file doesn't count
-                    if re.search(rf"\.{f}\b|['\"]{f}['\"]"
-                                 rf"|RAY_TPU_{f.upper()}", pf.source):
+                    if f not in read_fields[cls] and f in tokens:
                         read_fields[cls].add(f)
 
         for cls, info in classes.items():

@@ -7,65 +7,52 @@ the per-round timeout fires and the whole slice aborts. ``if rank ==
 0: broadcast(...)`` is the canonical deadlock — broadcast is collective
 even for the source rank.
 
-Flags calls whose callee is a known collective op when the call sits in
-an ``if``/ternary whose test mentions a rank-ish name AND the same op
-is not also called in the opposite branch (``broadcast(x) if rank == 0
-else broadcast(None)`` is convergent: every rank still makes the
-call). Matches bare names (``from ray_tpu.collective import barrier``)
-and dotted calls through a collective-ish receiver
-(``collective.barrier``, ``col.allreduce``, ``self.group.barrier``).
+Interprocedural since the raylint call-graph engine landed: a helper
+that hides the collective no longer hides the hazard —
+
+    if rank == 0:
+        _sync_weights(model)      # _sync_weights allreduces inside
+
+is flagged at the call site, with the path to the buried collective.
+The convergence check is symmetric: an op invoked (directly or through
+helpers) in *both* arms is a rendezvous every rank still reaches, so
+``broadcast(x) if rank == 0 else broadcast(None)`` stays clean even
+when one side routes through a wrapper.
+
+Matches bare names (``from ray_tpu.collective import barrier``) and
+dotted calls through a collective-ish receiver (``collective.barrier``,
+``col.allreduce``, ``self.group.barrier``).
 """
 
 from __future__ import annotations
 
-import ast
-from typing import List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
-from ray_tpu.devtools.lint.astutil import dotted_name
 from ray_tpu.devtools.lint.findings import Finding
 from ray_tpu.devtools.lint.registry import Rule, register
 
-_OPS = {
-    "allreduce", "allgather", "broadcast", "reducescatter", "barrier",
-    "allreduce_async", "allgather_async", "broadcast_async",
-    "reducescatter_async", "barrier_async",
-}
-_RECEIVER_WORDS = ("collective", "col", "group", "comm")
-_RANK_WORDS = ("rank", "is_leader", "is_root", "is_coordinator")
 
-
-def _collective_op(call: ast.Call) -> str:
-    """The op name if this is a collective call, else ''."""
-    name = dotted_name(call.func)
-    parts = name.split(".")
-    if parts[-1] not in _OPS:
-        return ""
-    if len(parts) > 1 and not any(w in p for p in parts[:-1]
-                                  for w in _RECEIVER_WORDS):
-        return ""
-    return parts[-1]
-
-
-def _mentions_rank(test: ast.AST) -> bool:
-    for node in ast.walk(test):
-        word = None
-        if isinstance(node, ast.Name):
-            word = node.id
-        elif isinstance(node, ast.Attribute):
-            word = node.attr
-        if word and any(w in word.lower() for w in _RANK_WORDS):
-            return True
-    return False
-
-
-def _branch_calls(nodes) -> List[Tuple[str, ast.Call]]:
-    out = []
-    for n in nodes:
-        for sub in ast.walk(n):
-            if isinstance(sub, ast.Call):
-                op = _collective_op(sub)
-                if op:
-                    out.append((op, sub))
+def _arm_ops(graph, module: str, cls: str, arm: dict,
+             cache: Dict[str, Dict[str, tuple]]
+             ) -> Dict[str, List[tuple]]:
+    """{op: [(line, col, via)]} for one branch arm: direct collective
+    calls plus collectives reachable through resolvable helper calls."""
+    out: Dict[str, List[tuple]] = {}
+    for op, line, col in arm["ops"]:
+        out.setdefault(op, []).append((line, col, ""))
+    for name, line, col in arm["calls"]:
+        callee = graph.resolve_call(module, cls, name)
+        if callee is None:
+            continue
+        if callee not in cache:
+            cache[callee] = graph.collectives_reachable(callee)
+        for op, (nid, path, site) in cache[callee].items():
+            owner = graph.summary(nid)
+            chain = " -> ".join(
+                [name] + [p[0] for p in path]
+                + ([owner.qualname] if owner is not None and path == []
+                   and nid != callee else []))
+            out.setdefault(op, []).append((line, col, chain))
     return out
 
 
@@ -73,33 +60,39 @@ def _branch_calls(nodes) -> List[Tuple[str, ast.Call]]:
 class DivergentCollective(Rule):
     id = "divergent-collective"
     doc = ("collective op called in one arm of an `if rank...` branch — "
-           "ranks that skip the call deadlock the group")
+           "ranks that skip the call deadlock the group (helpers are "
+           "followed through the call graph)")
     hint = ("hoist the collective out of the conditional (all ranks call "
             "it); branch on rank only around the non-collective work")
+    scope = "graph"
 
-    def check(self, parsed):
-        seen: Set[int] = set()
-        for node in ast.walk(parsed.tree):
-            if isinstance(node, ast.If) and _mentions_rank(node.test):
-                body, orelse = _branch_calls(node.body), \
-                    _branch_calls(node.orelse)
-            elif isinstance(node, ast.IfExp) and _mentions_rank(node.test):
-                body, orelse = _branch_calls([node.body]), \
-                    _branch_calls([node.orelse])
-            else:
-                continue
-            body_ops = {op for op, _ in body}
-            else_ops = {op for op, _ in orelse}
-            for op, call in body + orelse:
-                if op in body_ops and op in else_ops:
-                    continue  # convergent: both arms make the call
-                if id(call) in seen:
-                    continue
-                seen.add(id(call))
-                yield Finding(
-                    rule=self.id, path=parsed.path,
-                    line=call.lineno, col=call.col_offset,
-                    message=f"collective {dotted_name(call.func)}(...) "
-                            "inside a rank-dependent branch — ranks not "
-                            "taking this branch deadlock the group",
-                    hint=self.hint)
+    def check_graph(self, graph):
+        cache: Dict[str, Dict[str, tuple]] = {}
+        for nid, s in sorted(graph.functions.items()):
+            module = nid.split(":", 1)[0]
+            path = graph.fn_path.get(nid, "?")
+            for br in s.rank_branches:
+                arms = [_arm_ops(graph, module, s.cls, arm, cache)
+                        for arm in br["arms"]]
+                body_ops, else_ops = set(arms[0]), set(arms[1])
+                seen: Set[Tuple[int, int]] = set()
+                for arm_ops in arms:
+                    for op, sites in sorted(arm_ops.items()):
+                        if op in body_ops and op in else_ops:
+                            continue   # convergent: both arms reach it
+                        for line, col, via in sites:
+                            if (line, col) in seen:
+                                continue
+                            seen.add((line, col))
+                            where = (f"collective {op}(...)"
+                                     if not via else
+                                     f"call reaching collective {op} "
+                                     f"({via})")
+                            yield Finding(
+                                rule=self.id, path=path, line=line,
+                                col=col,
+                                message=(f"{where} inside a "
+                                         "rank-dependent branch — ranks "
+                                         "not taking this branch "
+                                         "deadlock the group"),
+                                hint=self.hint)

@@ -1,0 +1,465 @@
+"""Per-file analysis summaries for interprocedural raylint rules.
+
+One :class:`FileSummary` per parsed file captures everything the
+whole-program phase needs — per-function call sites, blocking
+operations, lock acquisitions, collective invocations, compiled-channel
+ops, rank-conditional branches, and per-class attribute types — as
+plain JSON-able data. The project call graph (callgraph.py) is built
+purely from summaries, never from ASTs, which is what makes the
+result cache work: a cache hit loads the summary and skips both the
+parse and the per-file extraction, and graph rules still see the file.
+
+Extraction is deliberately conservative: a receiver or callee the
+flow-insensitive pass cannot resolve is recorded raw and dropped at
+resolution time, trading recall for a near-zero false-positive rate
+(the tier-1 gate keeps the tree clean, so every false positive is a
+build break).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.devtools.lint.astutil import (FuncNode, decorator_names,
+                                           dotted_name, walk_scope)
+
+# Blocking object-store reads (same exact-chain table blocking_async
+# uses, plus the bare names `from ray_tpu import get/wait` would bind).
+BLOCKING_GET = {
+    "ray_tpu.get", "runtime.get", "rt.get", "_runtime.get", "_rt.get",
+}
+BLOCKING_WAIT = {
+    "ray_tpu.wait", "runtime.wait", "rt.wait", "_runtime.wait", "_rt.wait",
+}
+
+COLLECTIVE_OPS = {
+    "allreduce", "allgather", "broadcast", "reducescatter", "barrier",
+    "allreduce_async", "allgather_async", "broadcast_async",
+    "reducescatter_async", "barrier_async",
+}
+_COLLECTIVE_RECEIVERS = ("collective", "col", "group", "comm")
+_RANK_WORDS = ("rank", "is_leader", "is_root", "is_coordinator")
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock", "Lock": "lock",
+    "threading.RLock": "rlock", "RLock": "rlock",
+    "threading.Condition": "cond", "Condition": "cond",
+}
+_THREAD_CTORS = {"threading.Thread", "Thread", "multiprocessing.Process",
+                 "Process"}
+CHANNEL_OPS = {"execute", "teardown", "close", "put", "enqueue", "write",
+               "experimental_compile"}
+SHUTDOWN_METHODS = {"shutdown", "stop", "close", "teardown", "drain",
+                    "_stop", "_shutdown", "_close", "_teardown",
+                    "__exit__", "__del__", "atexit_handler"}
+
+
+def collective_op(call: ast.Call) -> str:
+    """The collective op name if this call is one, else ''."""
+    name = dotted_name(call.func)
+    parts = name.split(".")
+    if parts[-1] not in COLLECTIVE_OPS:
+        return ""
+    if len(parts) > 1 and not any(w in p for p in parts[:-1]
+                                  for w in _COLLECTIVE_RECEIVERS):
+        return ""
+    return parts[-1]
+
+
+def mentions_rank(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        word = None
+        if isinstance(node, ast.Name):
+            word = node.id
+        elif isinstance(node, ast.Attribute):
+            word = node.attr
+        if word and any(w in word.lower() for w in _RANK_WORDS):
+            return True
+    return False
+
+
+def module_name_for(path: str) -> str:
+    """Best-effort dotted module for a file path: the part from the last
+    `ray_tpu` component down, else the bare stem (fixtures, tmp files)."""
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    parts = [p for p in norm.split("/") if p]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts.pop()
+    for anchor in ("ray_tpu",):
+        if anchor in parts:
+            return ".".join(parts[parts.index(anchor):])
+    return parts[-1] if parts else "?"
+
+
+def _ctor_tag(value: ast.AST) -> str:
+    """'lock'|'rlock'|'cond'|'thread'|'compiled'|'actor:<Cls>'|'' for the
+    right-hand side of an assignment."""
+    if not isinstance(value, ast.Call):
+        return ""
+    name = dotted_name(value.func)
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    if name in _THREAD_CTORS:
+        return "thread"
+    tail = name.split(".")[-1]
+    if tail == "experimental_compile":
+        return "compiled"
+    if tail == "remote":
+        # Cls.remote(...) or Cls.options(...).remote(...)
+        parts = name.split(".")
+        if len(parts) == 2 and parts[0][:1].isupper():
+            return f"actor:{parts[0]}"
+        if isinstance(value.func, ast.Attribute) \
+                and isinstance(value.func.value, ast.Call):
+            inner = dotted_name(value.func.value.func)
+            ip = inner.split(".")
+            if ip[-1] == "options" and len(ip) == 2 \
+                    and ip[0][:1].isupper():
+                return f"actor:{ip[0]}"
+    return ""
+
+
+def _remote_targets(node: ast.AST) -> List[Dict[str, str]]:
+    """`recv.meth.remote(...)` call sites anywhere under ``node``:
+    [{'recv': 'self._replica', 'method': 'queue_len'}, ...]."""
+    out = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = dotted_name(sub.func)
+        parts = name.split(".")
+        if parts[-1] != "remote" or len(parts) < 3:
+            continue
+        out.append({"recv": ".".join(parts[:-2]), "method": parts[-2]})
+    return out
+
+
+@dataclass
+class FunctionSummary:
+    qualname: str                     # "Class.method" | "fn" | "fn.inner"
+    line: int
+    cls: str = ""                     # enclosing class name, "" if none
+    is_actor: bool = False            # enclosing class is @remote-decorated
+    is_async: bool = False
+    calls: List[List[Any]] = field(default_factory=list)   # [name, ln, col]
+    blocking: List[Dict[str, Any]] = field(default_factory=list)
+    collectives: List[List[Any]] = field(default_factory=list)
+    rank_branches: List[Dict[str, Any]] = field(default_factory=list)
+    lock_sections: List[Dict[str, Any]] = field(default_factory=list)
+    channel_ops: List[Dict[str, Any]] = field(default_factory=list)
+    local_types: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ClassSummary:
+    name: str
+    line: int
+    is_actor: bool = False
+    bases: List[str] = field(default_factory=list)
+    methods: List[str] = field(default_factory=list)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    attr_lines: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class FileSummary:
+    path: str
+    module: str
+    functions: List[FunctionSummary] = field(default_factory=list)
+    classes: List[ClassSummary] = field(default_factory=list)
+    imports: Dict[str, str] = field(default_factory=dict)
+    module_types: Dict[str, str] = field(default_factory=dict)
+    config: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "FileSummary":
+        fs = cls(path=doc["path"], module=doc["module"],
+                 imports=doc.get("imports", {}),
+                 module_types=doc.get("module_types", {}),
+                 config=doc.get("config", {}))
+        fs.functions = [FunctionSummary(**f) for f in doc.get("functions",
+                                                              [])]
+        fs.classes = [ClassSummary(**c) for c in doc.get("classes", [])]
+        return fs
+
+
+def _is_actor_class(node: ast.ClassDef) -> bool:
+    return any(d == "remote" or d.endswith(".remote")
+               for d in decorator_names(node))
+
+
+def _imports_of(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for alias in node.names:
+                out[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return out
+
+
+def _span(node: ast.AST) -> Tuple[int, int]:
+    return node.lineno, getattr(node, "end_lineno", node.lineno)
+
+
+class _FunctionExtractor:
+    """Builds one FunctionSummary from an ast function node."""
+
+    def __init__(self, fn: ast.AST, qualname: str, cls: str,
+                 is_actor: bool, bare_gets: Dict[str, str]):
+        self.fn = fn
+        self.bare_gets = bare_gets
+        self.s = FunctionSummary(
+            qualname=qualname, line=fn.lineno, cls=cls, is_actor=is_actor,
+            is_async=isinstance(fn, ast.AsyncFunctionDef))
+
+    def run(self) -> FunctionSummary:
+        s = self.s
+        rank_arm_nodes = []   # nodes already claimed by a rank branch
+        for node in walk_scope(self.fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tag = _ctor_tag(node.value)
+                if tag:
+                    s.local_types[node.targets[0].id] = tag
+            if isinstance(node, ast.If) and mentions_rank(node.test):
+                s.rank_branches.append({
+                    "line": node.lineno,
+                    "arms": [self._arm(node.body), self._arm(node.orelse)],
+                })
+                rank_arm_nodes.append(node)
+            elif isinstance(node, ast.IfExp) and mentions_rank(node.test):
+                s.rank_branches.append({
+                    "line": node.lineno,
+                    "arms": [self._arm([node.body]),
+                             self._arm([node.orelse])],
+                })
+            elif isinstance(node, ast.With):
+                self._with(node)
+            elif isinstance(node, ast.Call):
+                self._call(node)
+        self._channel_ops()
+        return s
+
+    # -- pieces ----------------------------------------------------------
+    def _arm(self, nodes) -> Dict[str, Any]:
+        ops, calls = [], []
+        for n in nodes:
+            for sub in ast.walk(n):
+                if not isinstance(sub, ast.Call):
+                    continue
+                op = collective_op(sub)
+                if op:
+                    ops.append([op, sub.lineno, sub.col_offset])
+                else:
+                    calls.append([dotted_name(sub.func), sub.lineno,
+                                  sub.col_offset])
+        return {"ops": ops, "calls": calls}
+
+    def _call(self, node: ast.Call) -> None:
+        s = self.s
+        name = dotted_name(node.func)
+        parts = name.split(".")
+        s.calls.append([name, node.lineno, node.col_offset])
+        op = collective_op(node)
+        if op:
+            s.collectives.append([op, node.lineno, node.col_offset])
+        short = name[5:] if name.startswith("self.") else name
+        if name in BLOCKING_GET or short in BLOCKING_GET \
+                or (len(parts) == 1
+                    and self.bare_gets.get(parts[0]) == "get"):
+            s.blocking.append({
+                "kind": "get", "name": name, "line": node.lineno,
+                "col": node.col_offset,
+                "targets": [t for a in node.args + [k.value for k in
+                                                    node.keywords]
+                            for t in _remote_targets(a)]})
+        elif name in BLOCKING_WAIT or short in BLOCKING_WAIT \
+                or (len(parts) == 1
+                    and self.bare_gets.get(parts[0]) == "wait"):
+            s.blocking.append({"kind": "wait", "name": name,
+                               "line": node.lineno, "col": node.col_offset,
+                               "targets": []})
+        elif name == "time.sleep":
+            secs: Optional[float] = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, (int, float)):
+                secs = float(node.args[0].value)
+            s.blocking.append({"kind": "sleep", "name": name,
+                               "line": node.lineno, "col": node.col_offset,
+                               "seconds": secs, "targets": []})
+        elif parts[-1] == "join" and len(parts) >= 2 and parts[0] != "?":
+            s.blocking.append({"kind": "join", "name": name,
+                               "recv": ".".join(parts[:-1]),
+                               "line": node.lineno, "col": node.col_offset,
+                               "targets": []})
+        elif parts[-1] == "wait" and len(parts) >= 2 and parts[0] != "?":
+            # cond.wait() — blocking unless it is the section's own lock
+            s.blocking.append({"kind": "cond-wait", "name": name,
+                               "recv": ".".join(parts[:-1]),
+                               "line": node.lineno, "col": node.col_offset,
+                               "targets": []})
+        elif parts[-1] == "acquire" and len(parts) >= 2 \
+                and parts[0] != "?":
+            self.s.lock_sections.append({
+                "expr": ".".join(parts[:-1]), "line": node.lineno,
+                "col": node.col_offset, "span": [node.lineno, node.lineno],
+                "acquire_only": True})
+
+    def _with(self, node: ast.With) -> None:
+        body_start = node.body[0].lineno if node.body else node.lineno
+        group = id(node) & 0xFFFFFFFF
+        for gi, item in enumerate(node.items):
+            expr = item.context_expr
+            if isinstance(expr, (ast.Name, ast.Attribute)):
+                name = dotted_name(expr)
+                if name.startswith("?"):
+                    continue
+                self.s.lock_sections.append({
+                    "expr": name, "line": node.lineno,
+                    "col": node.col_offset,
+                    "span": [body_start, _span(node)[1]],
+                    "acquire_only": False, "group": group,
+                    "group_idx": gi})
+
+    def _channel_ops(self) -> None:
+        """Ordered channel ops with (block, idx) identity so protocol
+        rules can reason about straight-line statement order."""
+        block_counter = [0]
+        BLOCK_ATTRS = ("body", "orelse", "finalbody")
+
+        def header_calls(stmt):
+            """Calls in a statement outside its nested blocks/scopes."""
+            skip = set()
+            for attr in BLOCK_ATTRS:
+                for s in getattr(stmt, attr, None) or ():
+                    skip.add(id(s))
+            for h in getattr(stmt, "handlers", None) or ():
+                skip.add(id(h))
+            stack = [c for c in ast.iter_child_nodes(stmt)
+                     if id(c) not in skip]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, FuncNode + (ast.Lambda,)):
+                    continue
+                if isinstance(n, ast.Call):
+                    yield n
+                stack.extend(ast.iter_child_nodes(n))
+
+        def visit_block(stmts) -> None:
+            block_counter[0] += 1
+            bid = block_counter[0]
+            for idx, stmt in enumerate(stmts):
+                for sub in header_calls(stmt):
+                    name = dotted_name(sub.func)
+                    parts = name.split(".")
+                    if len(parts) >= 2 and parts[-1] in CHANNEL_OPS \
+                            and parts[0] != "?":
+                        self.s.channel_ops.append({
+                            "recv": ".".join(parts[:-1]),
+                            "op": parts[-1], "line": sub.lineno,
+                            "col": sub.col_offset, "block": bid,
+                            "idx": idx})
+                for attr in BLOCK_ATTRS:
+                    sub_stmts = getattr(stmt, attr, None)
+                    if sub_stmts:
+                        visit_block(sub_stmts)
+                for h in getattr(stmt, "handlers", None) or ():
+                    visit_block(h.body)
+
+        visit_block(self.fn.body)
+
+
+def _class_summary(node: ast.ClassDef, module: str) -> ClassSummary:
+    cs = ClassSummary(name=node.name, line=node.lineno,
+                      is_actor=_is_actor_class(node),
+                      bases=[dotted_name(b).split(".")[-1]
+                             for b in node.bases])
+    for st in node.body:
+        if isinstance(st, FuncNode):
+            cs.methods.append(st.name)
+        elif isinstance(st, ast.Assign):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    tag = _ctor_tag(st.value)
+                    if tag:
+                        cs.attr_types[t.id] = tag
+                        cs.attr_lines[t.id] = st.lineno
+    # self.X = <ctor> anywhere in the class's methods
+    for fn in node.body:
+        if not isinstance(fn, FuncNode):
+            continue
+        for sub in walk_scope(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t = sub.targets[0]
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    tag = _ctor_tag(sub.value)
+                    if tag and t.attr not in cs.attr_types:
+                        cs.attr_types[t.attr] = tag
+                        cs.attr_lines[t.attr] = sub.lineno
+    return cs
+
+
+def summarize(tree: ast.Module, source: str, path: str) -> FileSummary:
+    """The per-file half of the interprocedural analysis; pure function
+    of the file content, which is what makes it cacheable."""
+    module = module_name_for(path)
+    fs = FileSummary(path=path, module=module)
+    fs.imports = _imports_of(tree)
+    bare_gets = {local: target.rsplit(".", 1)[1]
+                 for local, target in fs.imports.items()
+                 if target in ("ray_tpu.get", "ray_tpu.wait")}
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    tag = _ctor_tag(node.value)
+                    if tag:
+                        fs.module_types[t.id] = tag
+
+    # parent map for qualnames
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    def qualname_of(fn: ast.AST) -> Tuple[str, str, bool]:
+        names: List[str] = [fn.name]
+        cls, is_actor = "", False
+        cur = parents.get(id(fn))
+        while cur is not None and not isinstance(cur, ast.Module):
+            if isinstance(cur, ast.ClassDef):
+                if not cls:
+                    cls, is_actor = cur.name, _is_actor_class(cur)
+                names.append(cur.name)
+            elif isinstance(cur, FuncNode):
+                names.append(cur.name)
+            cur = parents.get(id(cur))
+        return ".".join(reversed(names)), cls, is_actor
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            fs.classes.append(_class_summary(node, module))
+        elif isinstance(node, FuncNode):
+            qn, cls, is_actor = qualname_of(node)
+            fs.functions.append(_FunctionExtractor(
+                node, qn, cls, is_actor, bare_gets).run())
+
+    from ray_tpu.devtools.lint.rules.config_drift import extract_config
+    fs.config = extract_config(tree, source, path)
+    return fs

@@ -30,6 +30,7 @@ class AsyncResult:
         self._done = threading.Event()
         self._collect_lock = threading.Lock()
         self._collector_started = False
+        self._collecting = False
         self._value = None
         self._error: Optional[BaseException] = None
         if callback is not None or error_callback is not None:
@@ -43,21 +44,30 @@ class AsyncResult:
         threading.Thread(target=self._collect, daemon=True).start()
 
     def _collect(self):
+        # The lock only claims the fetch; holding it across the get()
+        # would stall every wait(timeout) caller (they acquire it in
+        # _start_collector) for the full, unbounded collection.
         with self._collect_lock:
-            if self._done.is_set():
-                return
-            try:
-                vals = ray_tpu.get(self._refs)
-                self._value = vals[0] if self._single else list(
-                    itertools.chain.from_iterable(vals))
-                if self._callback:
-                    self._callback(self._value)
-            except BaseException as e:  # noqa: BLE001 — surfaced via .get()
-                self._error = e
-                if self._error_callback:
-                    self._error_callback(e)
-            finally:
-                self._done.set()
+            if self._done.is_set() or self._collecting:
+                claimed = False
+            else:
+                self._collecting = True
+                claimed = True
+        if not claimed:
+            self._done.wait()
+            return
+        try:
+            vals = ray_tpu.get(self._refs)
+            self._value = vals[0] if self._single else list(
+                itertools.chain.from_iterable(vals))
+            if self._callback:
+                self._callback(self._value)
+        except BaseException as e:  # noqa: BLE001 — surfaced via .get()
+            self._error = e
+            if self._error_callback:
+                self._error_callback(e)
+        finally:
+            self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> None:
         if self._done.is_set():

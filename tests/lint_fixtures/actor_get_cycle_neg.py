@@ -1,0 +1,28 @@
+"""Negative: blocking gets with no call-back cycle."""
+import ray_tpu
+
+
+@ray_tpu.remote
+class Worker:
+    def compute(self, x):
+        return x * 2
+
+
+@ray_tpu.remote
+class Driver:
+    def __init__(self):
+        self._w = Worker.remote()
+
+    def run(self, x):
+        # one-way: Worker never calls back into Driver
+        return ray_tpu.get(self._w.compute.remote(x))
+
+
+class PlainCoordinator:
+    """Not an actor: blocking gets on the driver are fine."""
+
+    def __init__(self):
+        self._w = Worker.remote()
+
+    def gather(self, xs):
+        return ray_tpu.get([self._w.compute.remote(x) for x in xs])

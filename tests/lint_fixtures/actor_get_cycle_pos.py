@@ -1,0 +1,24 @@
+"""Positive: blocking get cycle between two actors (A -> B -> A)."""
+import ray_tpu
+
+
+@ray_tpu.remote
+class Pinger:
+    def __init__(self):
+        self._peer = Ponger.remote()
+
+    def ping(self):
+        # the get hides one helper deep: interprocedural reach required
+        return self._relay()
+
+    def _relay(self):
+        return ray_tpu.get(self._peer.pong.remote())
+
+
+@ray_tpu.remote
+class Ponger:
+    def __init__(self):
+        self._peer = Pinger.remote()
+
+    def pong(self):
+        return ray_tpu.get(self._peer.ping.remote())

@@ -1,0 +1,30 @@
+"""Negative: snapshot under the lock, block outside it."""
+import threading
+import time
+
+import ray_tpu
+
+_LOCK = threading.Lock()
+
+
+def fetch_unlocked(pending):
+    with _LOCK:
+        refs = list(pending)   # snapshot only
+    return ray_tpu.get(refs)   # block off-lock
+
+
+def brief_pause():
+    with _LOCK:
+        time.sleep(0.01)   # sub-threshold sleep: tolerated
+        return 1
+
+
+class Waiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait(1.0)   # own condition releases its lock

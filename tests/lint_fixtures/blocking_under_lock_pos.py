@@ -1,0 +1,25 @@
+"""Positive: RPC get held under a lock, directly and via a helper."""
+import threading
+
+import ray_tpu
+
+_LOCK = threading.Lock()
+
+
+def fetch_locked(refs):
+    with _LOCK:
+        return ray_tpu.get(refs)
+
+
+class Cache:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._data = {}
+
+    def refresh(self, ref):
+        with self._mu:
+            self._data.update(self._pull(ref))
+
+    def _pull(self, ref):
+        # blocking get reached transitively from inside the lock
+        return ray_tpu.get(ref)

@@ -1,0 +1,27 @@
+"""Negative: teardown last, error-path teardown, shutdown that tears down."""
+
+
+def run_ok(dag, x):
+    ref = dag.execute(x)
+    dag.teardown()
+    return ref
+
+
+def error_path(dag, x, err):
+    if err:
+        dag.teardown()   # different statement list than the execute below
+    return dag.execute(x)
+
+
+class GoodRunner:
+    def __init__(self, dag):
+        self._comp = dag.experimental_compile()
+
+    def submit(self, x):
+        return self._comp.execute(x)
+
+    def close(self):
+        self._release()
+
+    def _release(self):
+        self._comp.teardown()

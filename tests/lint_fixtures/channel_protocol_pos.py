@@ -1,0 +1,28 @@
+"""Positive: use-after-teardown, put-after-close, leaked compiled graph."""
+
+
+def run_then_poke(dag, x):
+    ref = dag.execute(x)
+    dag.teardown()
+    return dag.execute(x)   # channel already released
+
+
+def push_after_close(ch, item):
+    ch.close()
+    ch.put(item)   # closed channel
+
+
+class Runner:
+    """Compiles a standing graph; shutdown() never tears it down."""
+
+    def __init__(self, dag):
+        self._comp = dag.experimental_compile()
+
+    def submit(self, x):
+        return self._comp.execute(x)
+
+    def shutdown(self):
+        self._drain()
+
+    def _drain(self):
+        return None

@@ -1,0 +1,29 @@
+"""Negative: globally consistent order; RLock re-entry is legal."""
+import threading
+
+_ALPHA = threading.Lock()
+_BETA = threading.Lock()
+_RE = threading.RLock()
+
+
+def first():
+    with _ALPHA:
+        with _BETA:
+            return 1
+
+
+def second():
+    with _ALPHA:
+        with _BETA:
+            return 2
+
+
+def reenter_rlock():
+    with _RE:
+        with _RE:   # reentrant by design
+            return 3
+
+
+def disjoint():
+    with _BETA:
+        return 4

@@ -1,0 +1,24 @@
+"""Positive: A-under-B here, B-under-A there; plus a Lock re-acquire."""
+import threading
+
+_ALPHA = threading.Lock()
+_BETA = threading.Lock()
+
+
+def forward():
+    with _ALPHA:
+        with _BETA:
+            return 1
+
+
+def backward():
+    with _BETA:
+        with _ALPHA:
+            return 2
+
+
+def reenter():
+    with _ALPHA:
+        # non-reentrant Lock: this blocks forever in a single thread
+        with _ALPHA:
+            return 3

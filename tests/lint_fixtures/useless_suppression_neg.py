@@ -1,0 +1,7 @@
+"""Negative: a used directive, and directives for rules not in the run."""
+
+
+def kick(actor, x):
+    # judged only when leaked-object-ref is active — and then the
+    # finding it suppresses makes it a *used* directive either way
+    actor.go.remote(x)  # raylint: disable=leaked-object-ref -- push

@@ -1,0 +1,11 @@
+"""Positive: directives that suppress nothing."""
+
+
+def kick(actor, x):
+    # a line-level disable of useless-suppression can never work (the
+    # rule honors only disable-file=), so it is stale by construction
+    return x  # raylint: disable=useless-suppression -- stale
+
+
+def all_for_nothing():
+    return 1  # raylint: disable=all -- nothing fires on this line

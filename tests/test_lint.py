@@ -1,21 +1,31 @@
-"""raylint: tier-1 gate + per-rule fixture suite.
+"""raylint: tier-1 gate + per-rule fixture suite + cache/call-graph tests.
 
 The gate (`test_ray_tpu_tree_is_clean`) runs the analyzer over the whole
 ray_tpu/ package and fails on any unsuppressed finding, which makes the
 rule suite a one-way ratchet: a hazard pattern added to the catalog can
 never regress back into the tree.
+
+The full-tree analysis runs exactly twice here (cold, then warm against
+the same cache) in a module-scoped fixture; the gate, the cache-hit
+test, and the warm-speed test all read those two runs — the wall-clock
+budget does not pay for the tree per test.
 """
 
+import ast
 import json
 import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
 from ray_tpu.devtools.lint import all_rules, rule_ids, run_lint
-from ray_tpu.devtools.lint.engine import collect_files
+from ray_tpu.devtools.lint import engine as lint_engine
+from ray_tpu.devtools.lint.callgraph import ProjectGraph
+from ray_tpu.devtools.lint.engine import LintReport, collect_files
+from ray_tpu.devtools.lint.summaries import summarize
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "ray_tpu")
@@ -27,15 +37,90 @@ def _fixture(rule_id: str, kind: str) -> str:
     return os.path.join(FIXTURES, f"{rule_id.replace('-', '_')}_{kind}.py")
 
 
+@pytest.fixture(scope="module")
+def tree_runs(tmp_path_factory):
+    """(cold_report, warm_report, cold_seconds, warm_seconds) over
+    ray_tpu/ with a shared result cache."""
+    cache = str(tmp_path_factory.mktemp("raylint_cache"))
+    t0 = time.perf_counter()
+    cold = run_lint([PKG], cache_dir=cache)
+    t_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm = run_lint([PKG], cache_dir=cache)
+    t_warm = time.perf_counter() - t0
+    return cold, warm, t_cold, t_warm
+
+
 # ---- the tier-1 gate -------------------------------------------------------
 
-def test_ray_tpu_tree_is_clean():
-    report = run_lint([PKG])
+def test_ray_tpu_tree_is_clean(tree_runs):
+    report = tree_runs[0]
     assert report.files_scanned > 100, "lint saw too few files — broken walk?"
     unsuppressed = report.unsuppressed
     msg = "\n".join(f.render() for f in unsuppressed)
     assert not unsuppressed, f"raylint findings in ray_tpu/:\n{msg}"
     assert report.parse_errors == 0
+
+
+# ---- result cache ----------------------------------------------------------
+
+def test_warm_run_serves_every_file_from_cache(tree_runs):
+    cold, warm = tree_runs[0], tree_runs[1]
+    assert cold.files_from_cache == 0
+    assert warm.files_from_cache == warm.files_scanned == cold.files_scanned
+    assert sorted(f.render() for f in warm.findings) == \
+        sorted(f.render() for f in cold.findings)
+
+
+def test_warm_run_is_fast(tree_runs):
+    _, _, t_cold, t_warm = tree_runs
+    assert t_warm < 0.20 * t_cold, (
+        f"warm cache run took {t_warm:.2f}s vs {t_cold:.2f}s cold "
+        f"({100 * t_warm / t_cold:.0f}%, budget 20%)")
+
+
+def test_cache_hit_skips_reanalysis(tmp_path, monkeypatch):
+    p = tmp_path / "m.py"
+    p.write_text("def f():\n    return 1\n")
+    cache = str(tmp_path / "cache")
+    analyzed = []
+    real = lint_engine._analyze_file
+
+    def spy(pf, file_rules, need_summary):
+        analyzed.append(pf.path)
+        return real(pf, file_rules, need_summary)
+
+    monkeypatch.setattr(lint_engine, "_analyze_file", spy)
+    run_lint([str(p)], cache_dir=cache)
+    assert analyzed == [str(p)]
+    rep = run_lint([str(p)], cache_dir=cache)
+    assert analyzed == [str(p)], "cache hit must not re-analyze"
+    assert rep.files_from_cache == 1
+    p.write_text("def f():\n    return 2\n")
+    run_lint([str(p)], cache_dir=cache)
+    assert len(analyzed) == 2, "content change must invalidate"
+
+
+def test_ruleset_version_bump_invalidates_cache(tmp_path, monkeypatch):
+    p = tmp_path / "m.py"
+    p.write_text("def f():\n    return 1\n")
+    cache = str(tmp_path / "cache")
+    analyzed = []
+    real = lint_engine._analyze_file
+
+    def spy(pf, file_rules, need_summary):
+        analyzed.append(pf.path)
+        return real(pf, file_rules, need_summary)
+
+    monkeypatch.setattr(lint_engine, "_analyze_file", spy)
+    run_lint([str(p)], cache_dir=cache)
+    run_lint([str(p)], cache_dir=cache)
+    assert len(analyzed) == 1
+    monkeypatch.setattr(lint_engine, "RULESET_VERSION",
+                        lint_engine.RULESET_VERSION + 1)
+    rep = run_lint([str(p)], cache_dir=cache)
+    assert len(analyzed) == 2, "version bump must invalidate every entry"
+    assert rep.files_from_cache == 0
 
 
 # ---- per-rule fixtures -----------------------------------------------------
@@ -56,10 +141,85 @@ def test_rules(rule_id):
     assert hits, f"{rule_id}: positive fixture triggered nothing"
     for f in hits:
         assert f.line > 0 and f.message and f.path.endswith("_pos.py")
+        assert f.severity == rule.severity
 
     neg = run_lint([_fixture(rule_id, "neg")], rules=[rule])
     bad = [f.render() for f in neg.unsuppressed if f.rule == rule_id]
     assert not bad, f"{rule_id}: negative fixture flagged:\n" + "\n".join(bad)
+
+
+# ---- call graph ------------------------------------------------------------
+
+def _graph(sources, depth=6):
+    files = []
+    for mod, src in sources.items():
+        src = textwrap.dedent(src)
+        files.append(summarize(ast.parse(src), src, f"{mod}.py"))
+    return ProjectGraph(files, depth=depth)
+
+
+def test_callgraph_actor_method_resolution():
+    g = _graph({"mods": """
+        import ray_tpu
+
+        class Base:
+            def ping(self):
+                return 1
+
+        @ray_tpu.remote
+        class Worker(Base):
+            def work(self):
+                return self.ping()
+
+        class Driver:
+            def __init__(self):
+                self._w = Worker.remote()
+    """})
+    # inherited method resolves through the base class
+    assert g.method_node("Worker", "ping") == "mods:Base.ping"
+    succ = {callee for callee, _ in g.successors(
+        g.method_node("Worker", "work"))}
+    assert "mods:Base.ping" in succ
+    # actor-method index and handle typing
+    assert g.actor_methods["work"] == ["Worker"]
+    assert g.attr_type("Driver", "_w") == ("actor:W" + "orker", "mods",
+                                           "Driver")
+
+
+def test_callgraph_depth_cap_and_cycles():
+    chain = "\n".join(
+        [f"def f{i}():\n    return f{i + 1}()" for i in range(5)]
+        + ["def f5():\n    return 0"])
+    g = _graph({"chain": chain}, depth=2)
+    reached = {nid for nid, _ in g.reach("chain:f0")}
+    assert "chain:f2" in reached and "chain:f3" not in reached
+
+    # mutual recursion terminates and reaches both nodes
+    g2 = _graph({"loop": """
+        def a():
+            return b()
+
+        def b():
+            return a()
+    """})
+    assert {nid for nid, _ in g2.reach("loop:a")} == {"loop:a", "loop:b"}
+
+
+def test_callgraph_cross_module_import_resolution():
+    g = _graph({
+        "helpers": """
+            def deep():
+                return 1
+        """,
+        "caller": """
+            from helpers import deep
+
+            def top():
+                return deep()
+        """})
+    assert g.resolve_call("caller", "", "deep") == "helpers:deep"
+    path = dict(g.reach("caller:top"))["helpers:deep"]
+    assert [site[0] for site in path] == ["deep"]
 
 
 # ---- suppressions ----------------------------------------------------------
@@ -93,6 +253,22 @@ def test_wrong_rule_suppression_does_not_mask(tmp_path):
     p = tmp_path / "supp3.py"
     p.write_text(src)
     report = run_lint([str(p)])
+    rules = [f.rule for f in report.unsuppressed]
+    assert "leaked-object-ref" in rules       # the real finding survives
+    assert "useless-suppression" in rules     # and the stale disable is debt
+
+
+def test_directive_in_string_literal_is_inert(tmp_path):
+    src = textwrap.dedent('''\
+        DOC = """example: # raylint: disable=leaked-object-ref"""
+
+
+        def kick(a, x):
+            a.go.remote(x)
+    ''')
+    p = tmp_path / "supp4.py"
+    p.write_text(src)
+    report = run_lint([str(p)])
     assert [f.rule for f in report.unsuppressed] == ["leaked-object-ref"]
 
 
@@ -116,17 +292,20 @@ def test_skips_pycache_and_generated(tmp_path):
     (tmp_path / "schema_pb2.py").write_text("x.go.remote(1)\n")
     (tmp_path / "protobuf").mkdir()
     (tmp_path / "protobuf" / "msgs.py").write_text("x.go.remote(1)\n")
+    (tmp_path / ".raylint_cache").mkdir()
+    (tmp_path / ".raylint_cache" / "stale.py").write_text("x.go.remote(1)\n")
     (tmp_path / "real.py").write_text("y = 1\n")
     files = collect_files([str(tmp_path)])
     assert [os.path.basename(f) for f in files] == ["real.py"]
 
 
-# ---- CLI: --json schema + summary line ------------------------------------
+# ---- CLI: --json schema + severity + summary line -------------------------
 
 def _run_cli(*args):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.run(
-        [sys.executable, "-m", "ray_tpu.devtools.lint", *args],
+        [sys.executable, "-m", "ray_tpu.devtools.lint", "--no-cache",
+         *args],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
 
 
@@ -135,17 +314,41 @@ def test_cli_json_schema():
     assert proc.returncode == 1, proc.stderr  # unsuppressed findings
     doc = json.loads(proc.stdout)  # stdout is pure JSON...
     assert "RAYLINT" in proc.stderr  # ...summary one-liner on stderr
-    assert doc["version"] == 1
+    assert doc["version"] == 2
     summary = doc["summary"]
-    for key in ("files_scanned", "files_skipped", "parse_errors",
-                "findings", "suppressed", "by_rule"):
+    for key in ("files_scanned", "files_skipped", "files_from_cache",
+                "parse_errors", "findings", "suppressed", "by_rule"):
         assert key in summary
     assert summary["findings"] >= 1
     assert summary["by_rule"].get("leaked-object-ref", 0) >= 1
     for f in doc["findings"]:
         assert set(f) == {"rule", "path", "line", "col", "message",
-                          "hint", "suppressed"}
+                          "hint", "severity", "suppressed"}
+        assert f["severity"] in ("error", "warn")
         assert isinstance(f["line"], int) and isinstance(f["suppressed"], bool)
+
+
+def test_report_reads_v1_documents():
+    v1 = {"version": 1,
+          "summary": {"files_scanned": 1, "findings": 1},
+          "findings": [{"rule": "leaked-object-ref", "path": "x.py",
+                        "line": 3, "col": 4, "message": "m", "hint": "",
+                        "suppressed": False}]}
+    rep = LintReport.from_dict(v1)
+    assert rep.findings[0].severity == "error"  # v1 default
+    assert rep.findings[0].line == 3
+    rep2 = LintReport.from_dict(rep.to_dict())  # v2 round-trip
+    assert rep2.findings[0].severity == "error"
+
+
+def test_cli_fail_on_threshold():
+    pos = _fixture("useless-suppression", "pos")
+    on_warn = _run_cli("--rule", "useless-suppression", pos)
+    assert on_warn.returncode == 1, on_warn.stdout + on_warn.stderr
+    on_error = _run_cli("--rule", "useless-suppression",
+                        "--fail-on", "error", pos)
+    assert on_error.returncode == 0, on_error.stdout + on_error.stderr
+    assert "useless-suppression" in on_error.stdout  # still reported
 
 
 def test_cli_summary_line_and_exit_codes():
@@ -172,3 +375,19 @@ def test_cli_changed_only_runs():
                                                    "lint_fixtures"))
     assert proc.returncode in (0, 1), proc.stderr
     assert "RAYLINT" in proc.stdout
+
+
+def test_cli_lint_subcommand():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def ray_tpu_lint(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.cli", "lint", "--no-cache",
+             *args],
+            capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+
+    clean = ray_tpu_lint(_fixture("leaked-object-ref", "neg"))
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "RAYLINT" in clean.stdout
+    dirty = ray_tpu_lint(_fixture("leaked-object-ref", "pos"))
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
