@@ -303,6 +303,15 @@ def cmd_doctor(args):
               f"{_mib(mem.get('spill_candidate_bytes'))} "
               f"({len(mem.get('spill_candidates', []))} object(s), "
               f"idle>={mem.get('cold_after_s')}s)")
+        tier = mem.get("spill_tier") or {}
+        if any(tier.values()):
+            print(f"  spill tier: {tier.get('spilled_objects', 0)} object(s) "
+                  f"on disk ({_mib(tier.get('spilled_bytes'))}), "
+                  f"{tier.get('spilled_then_dropped', 0)} spilled-then-"
+                  f"dropped from shm; lifetime spill "
+                  f"{_mib(tier.get('spill_bytes_total'))} / restore "
+                  f"{_mib(tier.get('restore_bytes_total'))} "
+                  f"({tier.get('restored_objects', 0)} restore(s))")
         by_node = {}
         for r in mem.get("top_holders", []):
             by_node.setdefault(r.get("node"), []).append(r)

@@ -154,6 +154,20 @@ class Config:
     # immediately, then given this long to finish in-flight streams
     # before the controller kills it.
     serve_drain_timeout_s: float = 10.0
+    # --- disaggregated serving (ray_tpu/serve/disagg.py) --------------------
+    # Tokens per KV page for the handoff/prefix-directory hashing (the
+    # sim granularity; the real engine hashes at its own page_size).
+    serve_disagg_page_tokens: int = 16
+    # Full KV pages per handoff chunk: the prefill replica put()s one
+    # store object per GROUP of pages, so the prefill->decode envelope
+    # carries O(prompt/group) refs instead of O(prompt/page).
+    serve_disagg_group_pages: int = 4
+    # Prefill-replica retention of directory-registered page groups
+    # (local LRU): evicting one drops its global-directory entry too.
+    # Retention past store capacity rides the nodelet spill tier.
+    serve_disagg_retained_groups: int = 512
+    # GCS global prefix directory LRU capacity (page-group entries).
+    gcs_prefix_dir_capacity: int = 4096
     # --- observability ------------------------------------------------------
     task_event_buffer_size: int = 10000          # ref: task_event_buffer.h:199
     metrics_report_interval_s: float = 5.0       # nodelet node-stats agent

@@ -130,6 +130,17 @@ class GcsServer:
             self.storage = MemoryGcsStorage()
         # node_id -> {actor_id_hex: {"addr", "worker_id"}} from re-registration
         self._hosted: Dict[NodeID, dict] = {}
+        # global KV-prefix directory (serve/disagg): page-group chain
+        # hash -> exported page-group object in the zero-copy store, so
+        # ANY replica can adopt a warm shared prefix instead of
+        # re-prefilling it. LRU-bounded; in-memory like edge_model —
+        # entries are a cache of what prefill replicas currently retain,
+        # re-registered on the next prefill after a GCS failover.
+        from collections import OrderedDict
+        self.prefix_dir: "OrderedDict[bytes, dict]" = OrderedDict()
+        self.prefix_dir_stats: Dict[str, int] = {
+            "registered": 0, "hits": 0, "misses": 0, "evicted": 0,
+            "dropped": 0}
 
     # ------------------------------------------------------------------ boot
 
@@ -198,6 +209,17 @@ class GcsServer:
         self.health.forget_node(node_id.hex())
         # ...and its memory attribution: the store died with the node
         self.memory.forget_node(node_id.hex())
+        # ...and prefix-directory entries whose exported page groups were
+        # owned there: their primary copies died with the store, so a
+        # lookup must miss (and the requester re-prefill) rather than
+        # hand out a dangling ref.
+        node_hex = node_id.hex()
+        stale = [h for h, e in self.prefix_dir.items()
+                 if e.get("owner_node") == node_hex]
+        for h in stale:
+            del self.prefix_dir[h]
+        if stale:
+            self.prefix_dir_stats["dropped"] += len(stale)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         await self._publish("node", {"node_id": node_id, "alive": False})
         # Restart actors that lived there (ref: gcs_actor_manager.cc:1100).
@@ -801,6 +823,78 @@ class GcsServer:
             if mem:
                 self.memory.update(f"nodelet:{node_hex[:12]}", node_hex, mem)
         return self.memory.report(node_stats, top_n=top_n)
+
+    # ------------------------------------------- global KV-prefix directory
+
+    async def rpc_prefix_register(self, entries: List[dict]) -> dict:
+        """serve/disagg: a prefill replica registers exported page-group
+        objects, keyed by the group-boundary page-chain hash. Entry:
+        {"hash", "ref", "owner", "owner_node", "nbytes", "group_tokens"}.
+        First-writer-wins across owners (same rule as PagePool.register)
+        so concurrent prefills of a shared prefix converge on one copy;
+        a re-register by the incumbent owner refreshes its entry."""
+        now = time.time()
+        for e in entries:
+            h = e["hash"]
+            cur = self.prefix_dir.pop(h, None)
+            if cur is not None and cur.get("owner") != e.get("owner"):
+                e = cur   # keep the incumbent's ref, just refresh LRU
+            e["last_touch"] = now
+            self.prefix_dir[h] = e
+            self.prefix_dir_stats["registered"] += 1
+        cap = max(int(getattr(self.cfg, "gcs_prefix_dir_capacity", 4096)), 1)
+        while len(self.prefix_dir) > cap:
+            self.prefix_dir.popitem(last=False)
+            self.prefix_dir_stats["evicted"] += 1
+        return {"size": len(self.prefix_dir)}
+
+    async def rpc_prefix_lookup(self, hashes: List[bytes]) -> List[Optional[dict]]:
+        """Resolve the longest warm leading run of page groups: one entry
+        (or None) per group-boundary hash, in order, stopping at the
+        first miss — a group is only adoptable if every group before it
+        is too (chain hashes encode position, not just content)."""
+        now = time.time()
+        out: List[Optional[dict]] = []
+        miss = False
+        for h in hashes:
+            e = None if miss else self.prefix_dir.get(h)
+            if e is None:
+                miss = True
+                self.prefix_dir_stats["misses"] += 1
+                out.append(None)
+            else:
+                e["last_touch"] = now
+                self.prefix_dir.move_to_end(h)
+                self.prefix_dir_stats["hits"] += 1
+                out.append(dict(e))
+        return out
+
+    async def rpc_prefix_drop(self, hashes: List[bytes],
+                              owner: str = "") -> int:
+        """A prefill replica evicted retained groups locally (or is
+        draining): its directory entries must go too, or lookups hand
+        out refs whose primaries are about to be unpinned. With owner
+        set, only that owner's entries drop (a different owner may have
+        re-registered the hash since)."""
+        n = 0
+        for h in hashes:
+            e = self.prefix_dir.get(h)
+            if e is None:
+                continue
+            if owner and e.get("owner") != owner:
+                continue
+            del self.prefix_dir[h]
+            n += 1
+        if n:
+            self.prefix_dir_stats["dropped"] += n
+        return n
+
+    async def rpc_prefix_stats(self) -> dict:
+        st = dict(self.prefix_dir_stats)
+        st["size"] = len(self.prefix_dir)
+        st["capacity"] = int(getattr(self.cfg, "gcs_prefix_dir_capacity",
+                                     4096))
+        return st
 
     async def rpc_edge_stats(self) -> Dict[str, dict]:
         return self.edge_model.stats()

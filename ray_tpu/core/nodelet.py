@@ -132,6 +132,11 @@ class Nodelet:
         self.primary_pins: set = set()
         self._spilled_then_dropped = 0
         self._restored = 0
+        # cumulative spill-tier traffic (bytes written to / read back
+        # from disk) — the observability plane's evidence of what the
+        # spill loop actually does, vs. the point-in-time on-disk gauge
+        self._spill_bytes_total = 0
+        self._restore_bytes_total = 0
         self._native_pulls = 0
         self.xfer_port = -1
         # source addr -> (xfer port or -1, cache expiry time)
@@ -953,6 +958,7 @@ class Nodelet:
                     del view
                     self.store.release(oid)
                 await asyncio.to_thread(self.spill.spill, oid, data)
+                self._spill_bytes_total += len(data)
             our_pin = 1 if oid in self.primary_pins else 0
             if self.store.evict_if_unpinned(oid, max_pins=our_pin):
                 self.primary_pins.discard(oid)
@@ -990,10 +996,35 @@ class Nodelet:
     async def rpc_pin_objects(self, oids: List[ObjectID]) -> dict:
         """Batched rpc_pin_object: one RPC pins a whole wave of primaries.
         The collective zero-copy transport puts pipeline_chunks sub-chunk
-        objects per ring step; pinning them individually would pay one
-        owner->nodelet round-trip per sub-chunk on the hot path."""
-        results = [(await self.rpc_pin_object(oid))["ok"] for oid in oids]
-        return {"ok": all(results), "pinned": sum(results)}
+        objects per ring step, and a KV handoff (serve/kv_transfer.py)
+        pins one object per page group; pinning them individually would
+        pay one awaited store transaction plus two memattr lock rounds
+        per object. One synchronous store sweep (the leaked ts_get
+        refcount IS the pin, exactly as rpc_pin_object) and a single
+        memattr batch instead."""
+        pinned, ok = 0, True
+        batch = []
+        for oid in oids:
+            if oid in self.primary_pins:
+                pinned += 1
+                continue
+            view = self.store.get_view(oid)
+            if view is None:
+                # already only on disk (or gone); the spill tier is the pin
+                if self.spill is not None and self.spill.contains(oid):
+                    pinned += 1
+                else:
+                    ok = False
+                continue
+            size = len(view)
+            del view  # keep the refcount from ts_get; release at unpin
+            self.primary_pins.add(oid)
+            batch.append((oid, size))
+            pinned += 1
+        if batch:
+            _memattr().attribute_pin_many(
+                batch, reason="primary", owner=self.node_id.hex()[:12])
+        return {"ok": ok, "pinned": pinned}
 
     async def _restore_local(self, oid: ObjectID) -> bool:
         """Disk → shm (ref: restore_spilled_object). False if absent/full."""
@@ -1020,6 +1051,7 @@ class Nodelet:
         del view
         self.store.seal(oid)
         self._restored += 1
+        self._restore_bytes_total += len(data)
         return True
 
     async def rpc_has_object(self, oid: ObjectID) -> bool:
@@ -1225,6 +1257,11 @@ class Nodelet:
             "spilled_bytes": (self.spill.bytes_spilled()
                               if self.spill is not None else 0),
             "restored_objects": self._restored,
+            # spill-tier lifecycle: objects dropped from shm after their
+            # disk copy became the pin, plus cumulative disk traffic
+            "spilled_then_dropped": self._spilled_then_dropped,
+            "spill_bytes_total": self._spill_bytes_total,
+            "restore_bytes_total": self._restore_bytes_total,
             "native_pulls": self._native_pulls,
             "serve_busy_rejections": (self.store.xfer_busy_rejections()
                                       if self.xfer_port > 0 else 0),

@@ -14,6 +14,12 @@ enforce:
   ``self.x.teardown()`` leaks the channels and the pinned actors of
   every instance (the router's drop-compiled/drain dance exists
   precisely because of this).
+- the KV-handoff lifecycle (serve/kv_transfer.py) rides the same
+  protocol: ``export()`` after the exporter's ``close()`` raises (the
+  pins are already withdrawn), and ``adopt()`` after the standing
+  decode channel's ``teardown()``/``close()`` resolves refs whose
+  primaries may already be unpinned — both are ordering errors, same
+  shape as put-after-close.
 
 Statement-order checks use the (block, idx) identity the summaries
 record — two ops only pair when they sit in the same statement list,
@@ -32,8 +38,8 @@ from ray_tpu.devtools.lint.findings import Finding
 from ray_tpu.devtools.lint.registry import Rule, register
 from ray_tpu.devtools.lint.summaries import SHUTDOWN_METHODS
 
-_TERMINAL = {"teardown": ("execute",),
-             "close": ("put", "enqueue", "write")}
+_TERMINAL = {"teardown": ("execute", "adopt"),
+             "close": ("put", "enqueue", "write", "export", "adopt")}
 
 
 @register

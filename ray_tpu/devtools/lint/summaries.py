@@ -51,7 +51,11 @@ _LOCK_CTORS = {
 _THREAD_CTORS = {"threading.Thread", "Thread", "multiprocessing.Process",
                  "Process"}
 CHANNEL_OPS = {"execute", "teardown", "close", "put", "enqueue", "write",
-               "experimental_compile"}
+               "experimental_compile",
+               # KV-handoff lifecycle (serve/kv_transfer.py): exporters
+               # and standing decode channels share the protocol —
+               # export/adopt are channel traffic, close/teardown ends it
+               "adopt", "export"}
 SHUTDOWN_METHODS = {"shutdown", "stop", "close", "teardown", "drain",
                     "_stop", "_shutdown", "_close", "_teardown",
                     "__exit__", "__del__", "atexit_handler"}

@@ -135,6 +135,35 @@ class MemoryTracker:
             elif delta:
                 self._add_bytes_locked(rec.subsystem, delta)
 
+    def attribute_pin_many(self, entries, subsystem: str = "user",
+                           reason: str = "primary", *,
+                           owner: Optional[str] = None) -> None:
+        """Batched attribute()+pin() for a wave of (key, nbytes) pairs —
+        one lock acquisition for the whole batch. Hot path: the nodelet
+        pinning every sub-chunk of a collective put or every page group
+        of a KV handoff in one rpc_pin_objects sweep."""
+        if not self.enabled or not entries:
+            return
+        now = time.monotonic()
+        with self._lock:
+            for key, nbytes in entries:
+                rec = self._recs.get(key)
+                if rec is None:
+                    rec = _Record(key, _key_hex(key), subsystem,
+                                  int(nbytes), True, owner, None, {}, now)
+                    self._recs[key] = rec
+                    self._add_bytes_locked(subsystem, rec.nbytes)
+                else:
+                    delta = int(nbytes) - rec.nbytes
+                    rec.nbytes = int(nbytes)
+                    if delta:
+                        self._add_bytes_locked(rec.subsystem, delta)
+                p = rec.pins.get(reason)
+                if p is None:
+                    rec.pins[reason] = {"count": 1}
+                else:
+                    p["count"] += 1
+
     def retag(self, key, subsystem: str, **detail) -> None:
         """Claim `key` for a subsystem. Applies to the local record when
         this process owns one; always also recorded in the bounded retag
@@ -491,15 +520,24 @@ class MemoryAggregator:
                      reverse=True)[:top_n]
 
         nodes: Dict[str, dict] = {}
+        spill_tier = {"spilled_objects": 0, "spilled_bytes": 0,
+                      "spilled_then_dropped": 0, "restored_objects": 0,
+                      "spill_bytes_total": 0, "restore_bytes_total": 0}
         for node_hex, st in (node_stats or {}).items():
             used = int(st.get("store_bytes") or 0)
             attributed = per_node_attr.get(node_hex, 0)
+            # per-node spill-tier lifecycle (nodelet rpc_node_stats):
+            # what the spill loop actually moved, not just candidates
+            node_spill = {k: int(st.get(k) or 0) for k in spill_tier}
+            for k, v in node_spill.items():
+                spill_tier[k] += v
             nodes[node_hex] = {
                 "store_bytes": used,
                 "store_capacity": st.get("store_capacity"),
                 "store_pinned_bytes": st.get("store_pinned_bytes"),
                 "attributed_store_bytes": attributed,
                 "coverage": (min(1.0, attributed / used) if used else 1.0),
+                **node_spill,
             }
         return {
             "ts": time.time(),
@@ -509,6 +547,7 @@ class MemoryAggregator:
             "subsystem_store_bytes": sub_store,
             "subsystem_hwm_bytes": hwm,
             "nodes": nodes,
+            "spill_tier": spill_tier,
             "top_holders": top,
             "spill_candidates": sorted(
                 spill, key=lambda r: r.get("idle_s", 0.0), reverse=True),

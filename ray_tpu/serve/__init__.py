@@ -25,6 +25,9 @@ from ray_tpu.serve.api import (Application, Deployment, deployment,
                                get_deployment_handle, run, shutdown, start,
                                status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.disagg import DisaggRouter
+from ray_tpu.serve.kv_transfer import (HandoffAdopter, HandoffExporter,
+                                       PrefixDirectory)
 from ray_tpu.serve.graph import DAGDriverImpl, InputNode, build_app
 from ray_tpu.serve.grpc_proxy import (GrpcServeClient, shutdown_grpc,
                                       start_grpc)
@@ -41,4 +44,5 @@ __all__ = [
     "get_multiplexed_model_id", "build_app", "InputNode", "DAGDriverImpl",
     "start_grpc", "shutdown_grpc", "GrpcServeClient",
     "LLMRouter", "SimLLMServer", "build_llm_app",
+    "DisaggRouter", "PrefixDirectory", "HandoffExporter", "HandoffAdopter",
 ]

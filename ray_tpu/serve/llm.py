@@ -950,6 +950,43 @@ class LLMEngine:
             self.step_n(decode_block)
         return req.generated
 
+    # ---- disagg KV handoff (serve/kv_transfer.py) --------------------------
+
+    def export_kv_pages(self, pages: List[int]):
+        """Host-side gather of physical KV pages for a prefill->decode
+        handoff (paged layout only; call under self.lock so a reclaim
+        can't recycle the pages mid-gather). Returns (k, v) numpy arrays
+        shaped (n_layers, n_kv_heads, len(pages), page_size, head_dim) —
+        the payload one page-group store object carries."""
+        assert self.kv_layout == "paged", "export needs kv_layout='paged'"
+        idx = self._jnp.asarray(pages, self._jnp.int32)
+        return (np.asarray(self.kp[:, :, idx]),
+                np.asarray(self.vp[:, :, idx]))
+
+    def import_kv_pages(self, page_hashes: List[bytes], k, v) -> int:
+        """Adopt externally-exported KV pages (disagg decode side):
+        allocate physical pages, write the payload in one scatter per
+        pool array, and register them under their chain hashes. Imported
+        pages park refcount-0/evictable exactly like pages a released
+        slot leaves behind, so the next submit's _try_admit_cached
+        adopts them with zero prefill compute — decode never re-runs the
+        prefix's prefill. Returns the number of NEW pages written
+        (already-registered hashes are reused, not rewritten)."""
+        assert self.kv_layout == "paged", "import needs kv_layout='paged'"
+        jnp = self._jnp
+        with self.lock:
+            pairs = self.pool.import_pages(list(page_hashes))
+            new = [(i, p) for i, (p, is_new) in enumerate(pairs) if is_new]
+            if not new:
+                return 0
+            sel = [i for i, _ in new]
+            idx = jnp.asarray([p for _, p in new], jnp.int32)
+            self.kp = self.kp.at[:, :, idx].set(
+                jnp.asarray(np.asarray(k)[:, :, sel], self.kp.dtype))
+            self.vp = self.vp.at[:, :, idx].set(
+                jnp.asarray(np.asarray(v)[:, :, sel], self.vp.dtype))
+            return len(new)
+
 
 class LLMServer:
     """Serve deployment hosting an engine; a background thread drives the
@@ -957,7 +994,30 @@ class LLMServer:
 
     def __init__(self, preset: str = "tiny", max_slots: int = 8,
                  eos_token: int = -1, params=None, cfg=None,
-                 decode_block: int = 8, **kw):
+                 decode_block: int = 8, mode: str = "monolithic",
+                 group_pages: Optional[int] = None,
+                 retained_groups: Optional[int] = None,
+                 use_directory: bool = True, **kw):
+        if mode not in ("monolithic", "prefill", "decode"):
+            raise ValueError(f"unknown LLMServer mode {mode!r}")
+        if mode != "monolithic":
+            # disagg handoff is expressed in physical KV pages + chain
+            # hashes: contiguous caches have neither
+            kw.setdefault("kv_layout", "paged")
+            if kw["kv_layout"] != "paged" or not kw.get("prefix_caching",
+                                                        True):
+                raise ValueError("disagg modes need kv_layout='paged' "
+                                 "with prefix_caching on")
+        from ray_tpu.core.config import GLOBAL_CONFIG as _gc
+        self.mode = mode
+        self.group_pages = (group_pages if group_pages is not None
+                            else _gc.serve_disagg_group_pages)
+        self.retained_groups = (retained_groups if retained_groups
+                                is not None
+                                else _gc.serve_disagg_retained_groups)
+        self.use_directory = use_directory
+        self._exporter = None   # lazy: needs the in-actor runtime
+        self._adopter = None
         self.engine = LLMEngine(cfg=cfg, params=params, preset=preset,
                                 max_slots=max_slots, eos_token=eos_token, **kw)
         # fused decode steps per host sync (1 = lowest latency per token,
@@ -1073,6 +1133,104 @@ class LLMServer:
             out["error"] = req.error
         yield out
 
+    # ---- disaggregated serving (serve/disagg.py) ---------------------------
+
+    def _ensure_transfer(self):
+        """Lazily build the kv_transfer plumbing — both ends need the
+        in-actor runtime (zero-copy put/get + gcs_call)."""
+        from ray_tpu.serve.kv_transfer import (HandoffAdopter,
+                                               HandoffExporter,
+                                               PrefixDirectory)
+        if self._adopter is None:
+            self._adopter = HandoffAdopter()
+        if self._exporter is None and self.mode == "prefill":
+            import uuid
+            directory = PrefixDirectory() if self.use_directory else None
+            self._exporter = HandoffExporter(
+                owner=f"llm-{uuid.uuid4().hex[:12]}",
+                page_tokens=self.engine.pool.page_size,
+                group_pages=self.group_pages,
+                retained_groups=self.retained_groups,
+                directory=directory)
+
+    async def prefill_request(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """mode="prefill": fill the prompt's KV pages (one generated
+        token's worth of engine work — prefill + registration; the token
+        is discarded, decode regenerates it bitwise-identically at
+        temperature 0), export the leading full page GROUPS through the
+        zero-copy store, and return the handoff envelope."""
+        assert self.mode == "prefill", self.mode
+        self._ensure_transfer()
+        body = body if isinstance(body, dict) else body.json()
+        prompt = list(body["prompt"])
+        res = await self.__call__({"prompt": prompt, "max_new_tokens": 1,
+                                   "temperature": 0.0})
+        if not isinstance(res, dict):   # Response: shed or engine error
+            status = getattr(res, "status_code", 500)
+            return {"error": (res.body or {}).get("error", "prefill failed"),
+                    "status": status}
+        from ray_tpu.serve.paged_kv import page_chain_hashes
+        eng = self.engine
+        ps = eng.pool.page_size
+        per_page = page_chain_hashes(prompt, ps)
+        with eng.lock:
+            cached = eng.pool.match_prefix(per_page)
+        # export only groups whose every page is registered (admission
+        # keeps >=1 tail token un-paged, so the final partial group
+        # never exports — the decode side tail-prefills it)
+        n_groups = len(cached) // self.group_pages
+        export_tokens = prompt[:n_groups * self.group_pages * ps]
+
+        def payload_for_group(s: int, e: int) -> dict:
+            p0, p1 = s // ps, e // ps
+            with eng.lock:
+                pages = eng.pool.match_prefix(per_page[:p1])[p0:p1]
+                if len(pages) != p1 - p0:
+                    raise RuntimeError("page group evicted before export")
+                k, v = eng.export_kv_pages(pages)
+            return {"k": k, "v": v, "page_hashes": per_page[p0:p1]}
+
+        # store puts + directory registration are blocking runtime calls
+        # — banned on the event-loop thread (raylint blocking-in-async)
+        envelope = await asyncio.to_thread(
+            self._exporter.export,
+            export_tokens, payload_for_group,
+            lambda p: int(p["k"].nbytes) + int(p["v"].nbytes),
+            prompt_len=len(prompt))
+        return {"envelope": envelope,
+                "matched_tokens": len(export_tokens)}
+
+    def ack_handoff(self, handoff_id: str) -> bool:
+        if self._exporter is None:
+            return False
+        return self._exporter.ack(handoff_id)
+
+    async def adopt_decode(self, envelope: Dict[str, Any], body) -> Any:
+        """mode="decode": map the envelope's page groups in from the
+        store (engine.import_kv_pages — registered + evictable, no
+        prefill compute), then serve the request through the normal
+        streaming path: admission's _try_admit_cached adopts the
+        imported pages and only the un-paged tail prefills."""
+        assert self.mode == "decode", self.mode
+        self._ensure_transfer()
+        try:
+            # blocking zero-copy gets: executor thread, not the loop
+            payloads = await asyncio.to_thread(self._adopter.adopt, envelope)
+            for payload in payloads:
+                self.engine.import_kv_pages(payload["page_hashes"],
+                                            payload["k"], payload["v"])
+        except Exception:
+            # exporter (or its store) died before we mapped the pages
+            # in: tell the router to re-prefill on a survivor
+            yield {"handoff_lost": True, "done": True}
+            return
+        async for frame in self.stream_request(body):
+            if isinstance(frame, dict) and frame.get("done") \
+                    and "handoff_id" not in frame and not frame.get("error"):
+                frame = dict(frame)
+                frame["handoff_id"] = envelope.get("handoff_id")
+            yield frame
+
     def queue_len(self) -> int:
         """Engine-side backlog: requests queued for admission plus slots
         mid-generation. The serve Replica adds this to its own RPC
@@ -1092,6 +1250,10 @@ class LLMServer:
         (ServeController._drain_then_kill) then polls queue_len() to 0
         before killing the actor."""
         self._draining = True
+        if self._exporter is not None:
+            # unpin retained + in-flight page groups and withdraw our
+            # global-directory entries before the controller kills us
+            self._exporter.close()
 
     def stats(self) -> Dict[str, Any]:
         m = dict(self.engine.metrics)
@@ -1101,6 +1263,13 @@ class LLMServer:
                 1 for s in self.engine.slots if s is not None)
             m["max_slots"] = self.engine.max_slots
         m["draining"] = self._draining
+        m["mode"] = self.mode
+        if self._exporter is not None:
+            m.update({f"handoff_{k}": v
+                      for k, v in self._exporter.stats().items()})
+        if self._adopter is not None:
+            m.update({f"adopt_{k}": v
+                      for k, v in self._adopter.stats().items()})
         if m["ttft_count"]:
             m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
             p50 = self.engine._m_ttft.quantile(0.5)

@@ -43,7 +43,22 @@ class SimLLMServer:
                  decode_s_per_token: float = 0.002,
                  tokens_per_frame: int = 4,
                  prefix_caching: bool = True,
-                 prefix_cache_pages: int = 64):
+                 prefix_cache_pages: int = 64,
+                 mode: str = "monolithic",
+                 page_tokens: int = _PAGE,
+                 group_pages: int = 4,
+                 retained_groups: int = 512,
+                 use_directory: bool = True,
+                 colocation_interference: float = 0.0):
+        if mode not in ("monolithic", "prefill", "decode"):
+            raise ValueError(f"unknown SimLLMServer mode {mode!r}")
+        self.mode = mode
+        self.page_tokens = int(page_tokens)
+        self.group_pages = int(group_pages)
+        self.retained_groups = int(retained_groups)
+        self.use_directory = use_directory
+        self._exporter = None   # lazy: needs the in-actor runtime
+        self._adopter = None
         self.max_slots = max_slots
         self.max_queue_depth = max_queue_depth
         self.prefill_s_per_token = prefill_s_per_token
@@ -51,6 +66,13 @@ class SimLLMServer:
         self.tokens_per_frame = max(int(tokens_per_frame), 1)
         self.prefix_caching = prefix_caching
         self.prefix_cache_pages = prefix_cache_pages
+        # co-location contention model (ref: DistServe §2): a prefill
+        # sharing the engine inflates every co-scheduled decode step by
+        # this factor per co-running prefill. A replica that runs only
+        # one phase (mode="prefill"/"decode") never pays it — the effect
+        # disaggregation removes.
+        self.colocation_interference = float(colocation_interference)
+        self._prefill_active = 0
         # LRU by insertion/touch order, like PagePool's reclaim of
         # refcount-0 cached pages: a replica whose routed working set
         # exceeds capacity THRASHES — the effect prefix affinity exists
@@ -68,7 +90,60 @@ class SimLLMServer:
             "requests": 0, "tokens_generated": 0, "rejected": 0,
             "prefix_hits": 0, "prefix_hit_tokens": 0,
             "admit_s": 0.0, "decode_block_s": 0.0,
-            "ttft_sum": 0.0, "ttft_count": 0}
+            "ttft_sum": 0.0, "ttft_count": 0,
+            # disagg counters (stay 0 in monolithic mode)
+            "prefills": 0, "prefill_tokens": 0,
+            "global_prefix_hits": 0, "global_prefix_hit_tokens": 0,
+            "decodes": 0, "handoffs_lost": 0,
+            "interference_stall_s": 0.0}
+
+    # -- disagg plumbing (mode="prefill" / "decode") -------------------------
+
+    def _ensure_transfer(self):
+        """Lazily build the exporter/adopter pair: both need the
+        in-actor runtime (zero-copy put/get + gcs_call), which exists
+        once the replica runs but not necessarily at construction."""
+        from ray_tpu.serve.kv_transfer import (HandoffAdopter,
+                                               HandoffExporter,
+                                               PrefixDirectory)
+        if self._adopter is None:
+            self._adopter = HandoffAdopter()
+        if self._exporter is None and self.mode == "prefill":
+            import uuid
+            directory = PrefixDirectory() if self.use_directory else None
+            self._exporter = HandoffExporter(
+                owner=f"sim-{uuid.uuid4().hex[:12]}",
+                page_tokens=self.page_tokens,
+                group_pages=self.group_pages,
+                retained_groups=self.retained_groups,
+                directory=directory)
+
+    def _global_adopt(self, prompt: List[int]) -> int:
+        """Resolve the longest directory-warm leading run of page
+        groups; groups owned elsewhere are fetched once (zero-copy get)
+        and seeded into our exporter so OUR envelopes re-reference the
+        original store objects instead of re-putting them. Returns warm
+        tokens (any owner)."""
+        from ray_tpu.serve.kv_transfer import group_boundary_hashes
+        ex = self._exporter
+        if ex is None or ex.directory is None:
+            return 0
+        gb = group_boundary_hashes(prompt, self.page_tokens,
+                                   self.group_pages)
+        hits = ex.directory.lookup(gb)
+        warm, foreign = 0, []
+        for h, e in zip(gb, hits):
+            if e is None:
+                break
+            warm += 1
+            if e["owner"] != ex.owner and not ex.has(h):
+                foreign.append((h, e))
+        if foreign:
+            self._adopter.adopt({"groups": [
+                {"hash": h, "ref": e["ref"], "nbytes": e["nbytes"]}
+                for h, e in foreign]})
+            ex.seed([(h, e["ref"], e["nbytes"]) for h, e in foreign])
+        return warm * ex.group_tokens
 
     # -- prefix cache sim: leading full pages by content hash ---------------
 
@@ -129,12 +204,146 @@ class SimLLMServer:
                 t0 = time.time()
                 # prefill cost scales with the UNCACHED prompt tail —
                 # this is the wall-clock effect prefix affinity buys
-                await asyncio.sleep(
-                    self.prefill_s_per_token * (len(prompt) - matched))
+                with self._lock:
+                    self._prefill_active += 1
+                try:
+                    await asyncio.sleep(
+                        self.prefill_s_per_token * (len(prompt) - matched))
+                finally:
+                    with self._lock:
+                        self._prefill_active -= 1
                 dt = time.time() - t0
                 with self._lock:
                     self.metrics["admit_s"] += dt
                 L = len(prompt)
+                ttft = None
+                i = 0
+                while i < max_new:
+                    n = min(self.tokens_per_frame, max_new - i)
+                    t1 = time.time()
+                    base = self.decode_s_per_token * n
+                    with self._lock:
+                        stall = base * self.colocation_interference \
+                            * self._prefill_active
+                        self.metrics["interference_stall_s"] += stall
+                    await asyncio.sleep(base + stall)
+                    with self._lock:
+                        self.metrics["decode_block_s"] += time.time() - t1
+                        self.metrics["tokens_generated"] += n
+                    if ttft is None:
+                        ttft = time.time() - t_sub
+                        with self._lock:
+                            self.metrics["ttft_sum"] += ttft
+                            self.metrics["ttft_count"] += 1
+                    yield {"tokens": [L + j for j in range(i, i + n)]}
+                    i += n
+                yield {"done": True, "n_tokens": max_new, "ttft_s": ttft}
+            finally:
+                with self._lock:
+                    self._active -= 1
+
+    async def prefill_request(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """mode="prefill": run (only) the prefill for `body["prompt"]`,
+        export the filled page groups through the zero-copy store, and
+        return the handoff envelope. Deterministic: prefill wall-clock
+        scales with the tokens NOT covered by the replica-local page
+        cache or the global prefix directory — a directory hit on a
+        second replica skips the shared prefix entirely."""
+        assert self.mode == "prefill", self.mode
+        import numpy as np
+        self._ensure_transfer()
+        prompt = list(body["prompt"])
+        with self._lock:
+            backlog = self._pending + self._active
+            if self._draining or (self.max_queue_depth is not None
+                                  and backlog >= self.max_queue_depth):
+                self.metrics["rejected"] += 1
+                return {"error": "sim queue full" if not self._draining
+                        else "replica draining", "status": 429}
+            self.metrics["requests"] += 1
+            self._pending += 1
+        async with self._slots:
+            with self._lock:
+                self._pending -= 1
+                self._active += 1
+            try:
+                t0 = time.time()
+                matched = self._match_and_register(prompt)
+                # directory lookup + store put are blocking runtime
+                # calls — banned on the event-loop thread (raylint
+                # blocking-in-async), so hop to an executor thread
+                warm = await asyncio.to_thread(self._global_adopt, prompt)
+                skip = max(matched, warm)
+                if warm > matched:
+                    with self._lock:
+                        self.metrics["global_prefix_hits"] += 1
+                        self.metrics["global_prefix_hit_tokens"] += \
+                            warm - matched
+                await asyncio.sleep(
+                    self.prefill_s_per_token * (len(prompt) - skip))
+                envelope = await asyncio.to_thread(
+                    self._exporter.export,
+                    prompt,
+                    lambda s, e: np.asarray(prompt[s:e], np.int32),
+                    lambda a: int(a.nbytes))
+                dt = time.time() - t0
+                with self._lock:
+                    self.metrics["admit_s"] += dt
+                    self.metrics["prefills"] += 1
+                    self.metrics["prefill_tokens"] += len(prompt) - skip
+                return {"envelope": envelope, "matched_tokens": skip,
+                        "prefill_s": dt}
+            finally:
+                with self._lock:
+                    self._active -= 1
+
+    def ack_handoff(self, handoff_id: str) -> bool:
+        """Router ack: the decode replica adopted (or the attempt was
+        abandoned) — release this handoff's pins."""
+        if self._exporter is None:
+            return False
+        return self._exporter.ack(handoff_id)
+
+    async def adopt_decode(self, envelope: Dict[str, Any], body) -> Any:
+        """mode="decode": map the envelope's page groups in from the
+        store (no re-serialize), then stream decode frames with the same
+        token-continuity contract as stream_request — token i of a
+        prompt of length L is L + i, so failover asserts stay exact."""
+        assert self.mode == "decode", self.mode
+        self._ensure_transfer()
+        body = body if isinstance(body, dict) else body.json()
+        max_new = int(body.get("max_new_tokens", 32))
+        with self._lock:
+            backlog = self._pending + self._active
+            if self._draining or (self.max_queue_depth is not None
+                                  and backlog >= self.max_queue_depth):
+                self.metrics["rejected"] += 1
+                shed = True
+            else:
+                self.metrics["requests"] += 1
+                self._pending += 1
+                shed = False
+        if shed:
+            yield {"error": "sim queue full" if not self._draining
+                   else "replica draining", "status": 429, "done": True}
+            return
+        t_sub = time.time()
+        async with self._slots:
+            with self._lock:
+                self._pending -= 1
+                self._active += 1
+            try:
+                try:
+                    # blocking zero-copy gets: executor thread, not loop
+                    await asyncio.to_thread(self._adopter.adopt, envelope)
+                except Exception:
+                    # the exporter (or its store) died before we mapped
+                    # the pages in: tell the router to re-prefill
+                    with self._lock:
+                        self.metrics["handoffs_lost"] += 1
+                    yield {"handoff_lost": True, "done": True}
+                    return
+                L = int(envelope.get("prompt_len", 0))
                 ttft = None
                 i = 0
                 while i < max_new:
@@ -151,7 +360,10 @@ class SimLLMServer:
                             self.metrics["ttft_count"] += 1
                     yield {"tokens": [L + j for j in range(i, i + n)]}
                     i += n
-                yield {"done": True, "n_tokens": max_new, "ttft_s": ttft}
+                with self._lock:
+                    self.metrics["decodes"] += 1
+                yield {"done": True, "n_tokens": max_new, "ttft_s": ttft,
+                       "handoff_id": envelope.get("handoff_id")}
             finally:
                 with self._lock:
                     self._active -= 1
@@ -178,8 +390,15 @@ class SimLLMServer:
             m["active_slots"] = self._active
             m["max_slots"] = self.max_slots
             m["draining"] = self._draining
+            m["mode"] = self.mode
         if m["ttft_count"]:
             m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
+        if self._exporter is not None:
+            m.update({f"handoff_{k}": v
+                      for k, v in self._exporter.stats().items()})
+        if self._adopter is not None:
+            m.update({f"adopt_{k}": v
+                      for k, v in self._adopter.stats().items()})
         return m
 
     def queue_len(self) -> int:
@@ -188,6 +407,10 @@ class SimLLMServer:
 
     def drain(self) -> None:
         self._draining = True
+        if self._exporter is not None:
+            # unpin retained + in-flight page groups and withdraw our
+            # directory entries before the controller kills us
+            self._exporter.close()
 
 
 def build_llm_app(*, name: str = "llm_server",
@@ -196,17 +419,49 @@ def build_llm_app(*, name: str = "llm_server",
                   autoscaling_config: Optional[dict] = None,
                   use_sim: bool = False,
                   router_kwargs: Optional[dict] = None,
+                  disaggregated: bool = False,
+                  prefill_replicas: Optional[int] = None,
+                  decode_replicas: Optional[int] = None,
+                  prefill_autoscaling_config: Optional[dict] = None,
+                  decode_autoscaling_config: Optional[dict] = None,
                   **llm_kwargs) -> Any:
     """Build the router-fronted serving application. llm_kwargs go to
     LLMServer (preset, max_slots, kv_layout, ...) — or to SimLLMServer
     when use_sim=True (tests/bench). Returns the Application; deploy
-    with serve.run(app, route_prefix=...)."""
+    with serve.run(app, route_prefix=...).
+
+    disaggregated=True builds the two-pool topology instead
+    (serve/disagg.py): `{name}_prefill` x prefill_replicas and
+    `{name}_decode` x decode_replicas behind a DisaggRouter ingress.
+    Prefill replicas fill paged-KV pages and export them through the
+    zero-copy store; decode replicas adopt and stream. Each pool
+    autoscales independently (the router report_loads per pool)."""
     if use_sim:
         server_cls = SimLLMServer
     else:
         from ray_tpu.serve.llm import LLMServer
 
         server_cls = LLMServer
+    if disaggregated:
+        from ray_tpu.serve.disagg import DisaggRouter
+
+        n_pf = prefill_replicas if prefill_replicas is not None \
+            else max(1, num_replicas // 2)
+        n_dec = decode_replicas if decode_replicas is not None \
+            else max(1, num_replicas - n_pf)
+        prefill = serve_api.deployment(
+            server_cls, name=f"{name}_prefill", num_replicas=n_pf,
+            autoscaling_config=prefill_autoscaling_config).bind(
+            mode="prefill", **llm_kwargs)
+        decode = serve_api.deployment(
+            server_cls, name=f"{name}_decode", num_replicas=n_dec,
+            autoscaling_config=decode_autoscaling_config).bind(
+            mode="decode", **llm_kwargs)
+        router = serve_api.deployment(
+            DisaggRouter, name=f"{name}_router", num_replicas=1).bind(
+            decode, prefill_app=prefill, policy=router_policy,
+            **(router_kwargs or {}))
+        return router
     llm = serve_api.deployment(
         server_cls, name=name, num_replicas=num_replicas,
         autoscaling_config=autoscaling_config).bind(**llm_kwargs)

@@ -187,7 +187,12 @@ class LLMRouter:
     # ---- replica view ------------------------------------------------------
 
     def _snapshot(self, force: bool = False) -> List[Tuple[str, Any]]:
-        rt = self._handle._get_router()
+        return self._snapshot_of(self._handle, force)
+
+    @staticmethod
+    def _snapshot_of(handle: DeploymentHandle,
+                     force: bool = False) -> List[Tuple[str, Any]]:
+        rt = handle._get_router()
         rt._ensure_poller()
         rt._refresh(force)
         with rt._lock:
@@ -199,66 +204,86 @@ class LLMRouter:
         load = self._inflight.get(key, 0) + st.get("pending", 0)
         return load * (1.0 + st.get("busy", 0.0))
 
+    def _poll_pool(self, handle: DeploymentHandle,
+                   stats_map: Dict[str, Dict[str, Any]]) -> Optional[set]:
+        """One stats sweep over a pool: poll each replica's stats(),
+        fold the busy-fraction EWMA into stats_map, prune departed
+        replicas. Returns the live key set (None: snapshot failed).
+        Pool-generic so DisaggRouter reuses it for the prefill pool."""
+        alpha = 0.5
+        try:
+            reps = self._snapshot_of(handle)
+        except Exception:
+            return None
+        now = time.time()
+        for key, replica in reps:
+            try:
+                raw = ray_tpu.get(
+                    replica.handle_request.remote("stats", (), {}, None),
+                    timeout=5)
+            except Exception:
+                continue   # dead replicas age out via the long-poll set
+            busy_s = float(raw.get("admit_s", 0.0)) + \
+                float(raw.get("decode_block_s", 0.0))
+            with self._lock:
+                prev = stats_map.get(key)
+                frac = 0.0
+                if prev is not None and now > prev["_ts"]:
+                    frac = max(busy_s - prev["_raw_busy_s"], 0.0) \
+                        / (now - prev["_ts"])
+                ewma = (frac if prev is None
+                        else alpha * frac + (1 - alpha) * prev["busy"])
+                stats_map[key] = {
+                    "pending": int(raw.get("pending", 0)),
+                    "active": int(raw.get("active_slots", 0)),
+                    "draining": bool(raw.get("draining", False)),
+                    "busy": min(ewma, 4.0),
+                    "_raw_busy_s": busy_s, "_ts": now,
+                }
+        with self._lock:
+            live = {k for k, _ in reps}
+            for k in list(stats_map):
+                if k not in live:
+                    del stats_map[k]
+        return live
+
+    def _report(self, deployment_name: str, depth: int) -> None:
+        """Push one pool's router-observed queue depth to the controller
+        so autoscaling sees demand the replicas haven't accepted yet."""
+        if not self._report_load:
+            return
+        try:
+            controller = ray_tpu.get_actor("_serve_controller",
+                                           namespace="serve")
+            ray_tpu.get(controller.report_load.remote(
+                deployment_name, self._reporter, depth), timeout=5)
+        except Exception:
+            pass   # controller restarting: next tick re-reports
+
     def _stats_loop(self):
         """Poll LLMServer.stats() per replica on a fixed cadence; derive
         the busy-fraction EWMA feeding the pressure score, and push the
         router's own queue depth to the controller so autoscaling sees
         demand the replicas haven't accepted yet."""
-        alpha = 0.5
         while not self._stop.wait(self._stats_interval):
+            self._stats_tick()
+
+    def _stats_tick(self):
+        live = self._poll_pool(self._handle, self._replica_stats)
+        if live is None:
+            return
+        with self._lock:
+            stale = [(k, c) for k, c in self._compiled.items()
+                     if k not in live]
+            for k, _ in stale:
+                del self._compiled[k]
+            depth = self._total_inflight
+        for _, comp in stale:   # off-lock: teardown RPCs block
             try:
-                reps = self._snapshot()
+                comp.teardown(kill_actors=False)
             except Exception:
-                continue
-            now = time.time()
-            for key, replica in reps:
-                try:
-                    raw = ray_tpu.get(
-                        replica.handle_request.remote("stats", (), {}, None),
-                        timeout=5)
-                except Exception:
-                    continue   # dead replicas age out via the long-poll set
-                busy_s = float(raw.get("admit_s", 0.0)) + \
-                    float(raw.get("decode_block_s", 0.0))
-                with self._lock:
-                    prev = self._replica_stats.get(key)
-                    frac = 0.0
-                    if prev is not None and now > prev["_ts"]:
-                        frac = max(busy_s - prev["_raw_busy_s"], 0.0) \
-                            / (now - prev["_ts"])
-                    ewma = (frac if prev is None
-                            else alpha * frac + (1 - alpha) * prev["busy"])
-                    self._replica_stats[key] = {
-                        "pending": int(raw.get("pending", 0)),
-                        "active": int(raw.get("active_slots", 0)),
-                        "draining": bool(raw.get("draining", False)),
-                        "busy": min(ewma, 4.0),
-                        "_raw_busy_s": busy_s, "_ts": now,
-                    }
-            with self._lock:
-                live = {k for k, _ in reps}
-                for k in list(self._replica_stats):
-                    if k not in live:
-                        del self._replica_stats[k]
-                stale = [(k, c) for k, c in self._compiled.items()
-                         if k not in live]
-                for k, _ in stale:
-                    del self._compiled[k]
-                depth = self._total_inflight
-            for _, comp in stale:   # off-lock: teardown RPCs block
-                try:
-                    comp.teardown(kill_actors=False)
-                except Exception:
-                    pass
-            if self._report_load:
-                try:
-                    controller = ray_tpu.get_actor("_serve_controller",
-                                                   namespace="serve")
-                    ray_tpu.get(controller.report_load.remote(
-                        self._handle.deployment_name, self._reporter,
-                        depth), timeout=5)
-                except Exception:
-                    pass   # controller restarting: next tick re-reports
+                pass
+        self._report(self._handle.deployment_name, depth)
 
     # ---- placement ---------------------------------------------------------
 
@@ -367,7 +392,7 @@ class LLMRouter:
                 try:
                     frames = await loop.run_in_executor(
                         self._executor, self._open_stream, key, replica,
-                        sub)
+                        (sub,))
                     while True:
                         try:
                             item = await loop.run_in_executor(
@@ -419,14 +444,18 @@ class LLMRouter:
 
     # ---- stream transport --------------------------------------------------
 
-    def _open_stream(self, key: str, replica, sub: dict):
+    def _open_stream(self, key: str, replica, args: tuple,
+                     method: str = "stream_request"):
         """Open one replica stream (blocking; executor thread). Compiled
         hop when enabled: a raw enqueue onto the replica's standing
-        channel; otherwise the per-call dispatch path."""
+        channel; otherwise the per-call dispatch path. The method is an
+        execute-time input on the standing graph, so the SAME channel
+        per replica carries any streaming method — stream_request for
+        the monolithic pool, adopt_decode for the disagg decode hop."""
         if self._compiled_hop:
             try:
                 comp = self._compiled_for(key, replica)
-                ref = comp.execute(method="stream_request", args=(sub,),
+                ref = comp.execute(method=method, args=args,
                                    kwargs={}, context=None)
                 with self._lock:
                     self.counters["compiled_streams"] += 1
@@ -441,7 +470,7 @@ class LLMRouter:
         with self._lock:
             self.counters["legacy_streams"] += 1
         gen = replica.handle_request_streaming.remote(
-            "stream_request", (sub,), {}, None)
+            method, args, {}, None)
         return _legacy_frames(gen)
 
     def _compiled_for(self, key: str, replica):

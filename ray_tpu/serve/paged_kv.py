@@ -213,6 +213,34 @@ class PagePool:
             self.hash_to_page[h] = p
             self.page_to_hash[p] = h
 
+    def import_pages(self, hashes: List[bytes]) -> List[tuple]:
+        """Allocate + register physical pages for externally-imported KV
+        (disagg adopt, serve/kv_transfer.py): each new page parks
+        refcount-0 in the evictable LRU — matchable by the next admit's
+        _try_admit_cached, reclaimable under pool pressure, exactly like
+        pages a released slot leaves behind. Returns (page, is_new)
+        pairs in hash order (existing registrations are reused with
+        is_new=False; the caller only writes KV into new pages). Stops
+        early if the pool is exhausted."""
+        out = []
+        for h in hashes:
+            p = self.hash_to_page.get(h)
+            if p is not None:
+                out.append((p, False))
+                continue
+            if not self.free:
+                self._reclaim(1)
+            if not self.free:
+                break
+            p = self.free.pop()
+            self.hash_to_page[h] = p
+            self.page_to_hash[p] = h
+            self.ref[p] = 0
+            self.evictable[p] = None
+            out.append((p, True))
+        self._track_mem()
+        return out
+
     def cache_stats(self) -> dict:
         return {"registered": len(self.hash_to_page),
                 "evictable": len(self.evictable),

@@ -173,7 +173,9 @@ def memory_summary() -> dict:
                 ("store_bytes", "store_capacity", "store_occupancy",
                  "store_pinned_bytes", "store_pinned_objects",
                  "store_pin_count_distribution", "spilled_bytes",
-                 "spilled_objects") if k in s}
+                 "spilled_objects", "spilled_then_dropped",
+                 "restored_objects", "spill_bytes_total",
+                 "restore_bytes_total") if k in s}
     except Exception:
         pass
     stats["nodes"] = nodes

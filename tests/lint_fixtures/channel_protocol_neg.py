@@ -13,6 +13,13 @@ def error_path(dag, x, err):
     return dag.execute(x)
 
 
+def handoff_ok(exporter, adopter, tokens, payload, nbytes, envelope):
+    env = exporter.export(tokens, payload, nbytes)
+    pages = adopter.adopt(envelope)   # adopt before any teardown: fine
+    exporter.close()                  # close LAST — legal lifecycle
+    return env, pages
+
+
 class GoodRunner:
     def __init__(self, dag):
         self._comp = dag.experimental_compile()

@@ -12,6 +12,16 @@ def push_after_close(ch, item):
     ch.put(item)   # closed channel
 
 
+def export_after_close(exporter, tokens, payload, nbytes):
+    exporter.close()
+    return exporter.export(tokens, payload, nbytes)   # pins withdrawn
+
+
+def adopt_after_teardown(chan, envelope):
+    chan.teardown()
+    return chan.adopt(envelope)   # refs may be unpinned already
+
+
 class Runner:
     """Compiles a standing graph; shutdown() never tears it down."""
 

@@ -16,6 +16,7 @@ import pytest
 import ray_tpu
 
 
+@pytest.mark.slow
 def test_tasks_survive_random_node_kills(ray_start_cluster):
     cluster = ray_start_cluster
     # head (driver) node + three killable worker nodes; head has no CPU
@@ -57,6 +58,7 @@ def test_tasks_survive_random_node_kills(ray_start_cluster):
     assert killed, "chaos thread never killed a node"
 
 
+@pytest.mark.slow
 def test_objects_survive_owner_visible_kill(ray_start_cluster):
     """Objects whose primary copy dies are reconstructed from lineage
     while chaos is ongoing (ref: test_reconstruction under chaos)."""

@@ -246,6 +246,7 @@ def test_cross_transport_bitwise_equivalence(ray_start_regular):
     assert tp["mode"] == "auto" and tp["zerocopy_threshold_bytes"] == 256 * 1024
 
 
+@pytest.mark.slow
 def test_zerocopy_chaos_member_death_raises(ray_start_regular):
     """Killing a rank mid-round on the ZERO-COPY path raises
     CollectiveTimeoutError naming the rank — survivors never hang on a

@@ -12,6 +12,7 @@ import ray_tpu
 from ray_tpu.core.status import TaskCancelledError
 
 
+@pytest.mark.slow
 def test_cancel_queued_task(ray_start_regular, tmp_path):
     """A task parked behind a long-running one cancels without ever
     executing."""

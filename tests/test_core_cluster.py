@@ -104,6 +104,7 @@ def test_placement_group_infeasible_pending(ray_start_cluster):
     assert not pg.ready(timeout=0.5)
 
 
+@pytest.mark.slow
 def test_node_death_actor_restart(ray_start_cluster):
     cluster = ray_start_cluster
     cluster.add_node(resources={"CPU": 2.0})           # driver's node

@@ -139,6 +139,7 @@ def test_gcs_restart_task_events_and_new_nodes(ft_cluster):
     assert ray_tpu.get(h.remote(), timeout=60) == "on-late-node"
 
 
+@pytest.mark.slow
 def test_gcs_restart_actor_lost_during_downtime(ft_cluster):
     """An ALIVE actor whose node dies while the GCS is down is detected at
     failover reconciliation and restarted elsewhere (ref: failover

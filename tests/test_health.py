@@ -261,6 +261,7 @@ class _RingMember:
         return col.allreduce(np.ones(4), group).tolist()
 
 
+@pytest.mark.slow
 def test_collective_stall_names_suspect_rank_and_dumps(tmp_path):
     """Rank 0 goes quiet mid-round; the others' beacons (armed with the
     rank they wait on) must cross the stall deadline and surface as

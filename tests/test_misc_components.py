@@ -129,6 +129,7 @@ def test_autoscaler_scales_up_and_down(ray_start_cluster):
     assert terminated, "autoscaler did not scale down the idle node"
 
 
+@pytest.mark.slow
 def test_stack_and_internal_stats(ray_start_regular):
     """ref: `ray stack` (scripts.py:1789) and event_stats.h handler
     instrumentation surfaced per daemon."""

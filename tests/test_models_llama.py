@@ -163,6 +163,7 @@ def test_sliding_window_attention():
     assert float(jnp.abs(banded[:, -1] - full[:, -1]).max()) > 1e-3
 
 
+@pytest.mark.slow
 def test_sliding_window_decode_and_guards():
     """decode_step applies the same band as training (identical to
     full-causal decode before W, diverges after); ring/ulysses reject

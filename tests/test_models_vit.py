@@ -75,6 +75,7 @@ def test_registry_has_vit():
     assert hasattr(mod, "predict_fn")
 
 
+@pytest.mark.slow
 def test_batch_inference_over_dataset(ray_start_regular):
     from ray_tpu import data
 

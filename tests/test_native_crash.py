@@ -109,6 +109,7 @@ def test_eownerdead_recovery_and_reap(store, tmp_path):
             child.kill()
 
 
+@pytest.mark.slow
 def test_kill_storm_keeps_store_consistent(store):
     """Probabilistic sweep: children hammer create/seal/delete while the
     parent SIGKILLs them at random points; afterwards the store must

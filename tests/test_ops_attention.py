@@ -61,6 +61,7 @@ def test_ring_attention_matches_reference():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match():
     mesh = jax.make_mesh((4,), ("sp",),
                          axis_types=(jax.sharding.AxisType.Auto,))

@@ -88,6 +88,7 @@ def test_build_pipeline_factories():
     assert isinstance(p.connectors[1], FlattenObs)
 
 
+@pytest.mark.slow
 def test_ppo_with_connectors():
     """PPO trains through a Normalize+FrameStack pipeline; worker stats
     merge and broadcast each iteration."""

@@ -34,6 +34,7 @@ def test_multi_agent_env_protocol():
     assert term["__all__"] is True
 
 
+@pytest.mark.slow
 def test_multi_agent_ppo_learns(cluster):
     from ray_tpu.rl import MultiAgentPPOConfig, MultiAgentPPOTrainer
 

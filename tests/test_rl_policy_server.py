@@ -37,6 +37,7 @@ def test_policy_server_protocol():
         srv.shutdown()
 
 
+@pytest.mark.slow
 def test_external_ppo_learns_cartpole():
     """An external CartPole simulator (the client) drives episodes
     against the learning server — the reference's cartpole_server /

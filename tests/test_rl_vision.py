@@ -107,6 +107,7 @@ def test_ppo_cnn_learns_pixel_catcher(ray_start_regular):
         tr.stop()
 
 
+@pytest.mark.slow
 def test_impala_cnn_pixel(ray_start_regular):
     """IMPALA's decoupled learner consumes pixel batches through the same
     CNN dispatch; short run — asserts the async loop turns over and the

@@ -154,6 +154,7 @@ def test_maml_trains_and_adapts(cluster):
         t.stop()
 
 
+@pytest.mark.slow
 def test_maml_meta_gradient_flows_through_inner_step():
     """The meta-gradient must differ from the plain gradient at the same
     point — i.e. the inner adaptation is differentiated through, not

@@ -318,6 +318,7 @@ def test_chunked_prefill_interleaves_decode():
     assert r_long.generated == want_long
 
 
+@pytest.mark.slow
 def test_int8_quantized_engine_serves():
     """Weight-only int8 (serving path for 7B-in-16GB, BASELINE.md target
     4): the quantized engine generates sane tokens on both layouts, its

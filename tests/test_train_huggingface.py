@@ -51,6 +51,7 @@ def _init_hf_trainer(train_shard, eval_shard, **config):
                    data_collator=collate)
 
 
+@pytest.mark.slow
 def test_transformers_trainer_single_worker(cluster):
     from ray_tpu.train import TransformersTrainer
 
@@ -106,6 +107,7 @@ def _accelerate_loop(config):
                     "rank": acc.process_index})
 
 
+@pytest.mark.slow
 def test_accelerate_trainer_two_workers(cluster):
     from ray_tpu.train import AccelerateTrainer
 

@@ -37,6 +37,7 @@ def _keras_loop(config):
               callbacks=[ReportCheckpointCallback()])
 
 
+@pytest.mark.slow
 def test_keras_callback_reports(cluster):
     from ray_tpu.train import JaxTrainer
 

@@ -34,6 +34,7 @@ def test_sklearn_fit_and_checkpoint(ray_start_regular, tmp_path):
     assert (model.predict(X[:10]) == y[:10]).mean() > 0.7
 
 
+@pytest.mark.slow
 def test_sklearn_cv_parallel(ray_start_regular, tmp_path):
     from sklearn.tree import DecisionTreeClassifier
 

@@ -56,6 +56,7 @@ def _torch_loop(config):
     return float(loss)
 
 
+@pytest.mark.slow
 def test_torch_trainer_ddp(cluster, tmp_path):
     from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
 

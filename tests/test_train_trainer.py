@@ -69,6 +69,7 @@ def test_trainer_e2e(ray_start_regular, tmp_path):
             < result.metrics_history[0]["loss"])
 
 
+@pytest.mark.slow
 def test_trainer_failure_restart(ray_start_regular, tmp_path):
     """Worker dies mid-run; trainer restarts the group from the latest
     checkpoint (ref: backend_executor.py:564,625 + FailureConfig)."""
@@ -118,6 +119,7 @@ def test_worker_group_elastic_resize(ray_start_regular):
         wg.shutdown()
 
 
+@pytest.mark.slow
 def test_hang_watchdog_restarts_from_checkpoint(ray_start_regular, tmp_path):
     """SURVEY §7 hard parts: a live-but-hung worker (stuck pjit program)
     never dies on its own — the hang watchdog kills the group and fit()

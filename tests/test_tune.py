@@ -74,6 +74,7 @@ def test_trial_error_isolated(ray_start_regular):
     assert results.get_best_result().metrics["ok"] == 1
 
 
+@pytest.mark.slow
 def test_tpe_searcher(ray_start_regular):
     """TPE should concentrate samples near the optimum after startup."""
 
@@ -188,6 +189,7 @@ def test_pbt_exploit_transfers_checkpoint(ray_start_regular):
     assert finals[0] > 0.1, finals
 
 
+@pytest.mark.slow
 def test_bayesopt_search_beats_random_on_quadratic(ray_start_regular):
     """GP+EI must concentrate samples near the optimum of a smooth
     objective (ref: BayesOptSearch wrapper semantics)."""

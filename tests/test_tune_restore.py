@@ -62,6 +62,7 @@ def _exp_state(run_dir):
         return {}
 
 
+@pytest.mark.slow
 def test_tuner_restore_after_driver_kill(ray_start_regular, tmp_path):
     marker = tmp_path / "markers"
     marker.mkdir()
