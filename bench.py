@@ -1011,6 +1011,162 @@ def run_dag_bench(chain_len: int = 4, iters: int = 150,
     return result
 
 
+def _elastic_bench_loop(config):
+    """Shared loop for the elastic bench cells: optional hard-exit of
+    one rank (chaos) and optional generation-1 slowdown (straggler);
+    every step couples the gang through a host-collective allreduce so
+    one slow rank degrades everyone, like a real pjit program."""
+    import os as _os
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu import collective as col
+    from ray_tpu.train import session
+
+    ck = session.get_checkpoint()
+    start = ck.load_state()["step"] if ck else 0
+    gen = session.get_context().elastic_meta.get("generation", 1)
+    group = session.get_collective_group()
+    for step in range(start, config["steps"]):
+        slow = (gen == 1
+                and session.world_rank() == config.get("slow_rank", -1)
+                and step >= config.get("slow_from", 1 << 30))
+        t0 = _time.time()
+        _time.sleep(config.get("slow_s", 0.3) if slow else 0.01)
+        compute = _time.time() - t0
+        if group and session.world_size() > 1:
+            col.allreduce(np.ones(2, dtype=np.float32), group)
+        session.report({"step": step, "compute_s": compute},
+                       state={"step": step + 1})
+        if (ck is None
+                and session.world_rank() == config.get("die_rank", -1)
+                and step == config.get("die_at", -1)):
+            _os._exit(1)
+    return "done"
+
+
+def run_train_elastic_bench(steps: int = 16,
+                            out_path: str = "BENCH_train_elastic.json"):
+    """Self-healing elastic training: what a fault costs. Three fits of
+    the same collectively-coupled loop on a 2-worker CPU gang: (1) no
+    fault — steady-state step time; (2) chaos — rank 1 hard-exits
+    mid-run, the cell reports the remediation outage (largest hole in
+    rank 0's report stream: quarantine + respawn + collective re-form
+    + checkpoint resume) and the post-recovery step time; (3)
+    straggler — rank 1 slows ~30x on generation 1, the cell reports
+    pre/slow/post gang step times and the demotion outage. Headline =
+    chaos recovery seconds; vs_baseline = post-recovery step time /
+    steady step time (acceptance: ~1x — recovery is complete).
+    Single-core runnable via `python bench.py --bench train_elastic`."""
+    import os
+    import statistics
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import ray_tpu
+    from ray_tpu.train import (Backend, ElasticConfig, JaxTrainer,
+                               RunConfig, ScalingConfig)
+    from ray_tpu.train.config import CheckpointConfig
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+
+    def fit(name, loop_cfg, **elastic_kw):
+        trainer = JaxTrainer(
+            _elastic_bench_loop,
+            train_loop_config=dict({"steps": steps}, **loop_cfg),
+            scaling_config=ScalingConfig(
+                num_workers=2, use_tpu=False,
+                resources_per_worker={"CPU": 0.5},
+                elastic=ElasticConfig(min_workers=1, poll_interval_s=0.1,
+                                      **elastic_kw)),
+            run_config=RunConfig(
+                name=name,
+                storage_path=tempfile.mkdtemp(prefix="bench_elastic_"),
+                checkpoint_config=CheckpointConfig(num_to_keep=2)),
+            backend=Backend())
+        r = trainer.fit()
+        assert r.ok, f"{name}: {r.error}"
+        return r
+
+    def rank0_times(result):
+        by_step = {}
+        for r in result.metrics_history:
+            if r["_rank"] == 0:
+                by_step[r["step"]] = r["_ts"]       # last occurrence wins
+        return [by_step[s] for s in sorted(by_step)]
+
+    def step_gaps(ts, lo, hi):
+        return [ts[i + 1] - ts[i]
+                for i in range(max(lo, 0), min(hi, len(ts) - 1))]
+
+    def outage(result):
+        # largest wall-clock hole in rank 0's report stream == the
+        # remediation: drain, quarantine, respawn, re-setup, resume
+        ts = sorted(r["_ts"] for r in result.metrics_history
+                    if r["_rank"] == 0)
+        return max(ts[i + 1] - ts[i] for i in range(len(ts) - 1))
+
+    # 1. steady state: the same gang and loop with no fault (first two
+    #    gaps skipped: the peers' first-save orbax cold start couples in)
+    base = fit("bench-steady", {})
+    steady = statistics.median(step_gaps(rank0_times(base), 2, steps))
+
+    # 2. chaos: rank 1 hard-exits at step 3
+    chaos = fit("bench-chaos", {"die_rank": 1, "die_at": 3})
+    recovery = outage(chaos)
+    kts = rank0_times(chaos)
+    chaos_post = statistics.median(step_gaps(kts, steps - 6, steps))
+
+    # 3. straggler: rank 1 slows from step 6 until demoted
+    slow_from = 6
+    strag = fit("bench-straggler",
+                {"slow_rank": 1, "slow_from": slow_from, "slow_s": 0.3},
+                refill=False, grow=False, straggler_k=3.0,
+                straggler_min_reports=4)
+    sts = rank0_times(strag)
+    ray_tpu.shutdown()
+
+    result = {
+        "metric": "elastic_chaos_recovery_s",
+        "value": round(recovery, 2),
+        "unit": "s",
+        "vs_baseline": round(chaos_post / max(steady, 1e-9), 2),
+        "extra": {
+            "steps": steps,
+            "steady_step_s": round(steady, 4),
+            "chaos": {
+                "recovery_s": round(recovery, 2),
+                "post_step_s": round(chaos_post, 4),
+                "world_sizes": chaos.elastic["world_sizes"],
+                "remediations": [e["action"] for e in
+                                 chaos.elastic["remediations"]],
+            },
+            "straggler": {
+                "pre_step_s": round(statistics.median(
+                    step_gaps(sts, 2, slow_from - 1)), 4),
+                "slow_step_s": round(max(
+                    step_gaps(sts, slow_from, slow_from + 2)), 4),
+                "post_step_s": round(statistics.median(
+                    step_gaps(sts, steps - 5, steps)), 4),
+                "demotion_outage_s": round(outage(strag), 2),
+                "world_sizes": strag.elastic["world_sizes"],
+            },
+            "note": "vs_baseline = chaos post-recovery step time / "
+                    "no-fault steady step time (~1x means the refilled "
+                    "gang fully recovered); recovery_s is the largest "
+                    "hole in rank 0's report stream, i.e. the whole "
+                    "quarantine -> respawn -> collective re-form -> "
+                    "checkpoint-resume sequence",
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
+
+
 def run_telemetry_bench(inc_iters: int = 50_000, flush_iters: int = 300,
                         dispatch_tasks: int = 100,
                         out_path: str = "BENCH_telemetry.json"):
@@ -1363,7 +1519,7 @@ if __name__ == "__main__":
     ap.add_argument("--bench", default="train",
                     choices=("train", "collective", "data", "telemetry",
                              "serve_router", "serve_disagg", "dag",
-                             "memory"),
+                             "memory", "train_elastic"),
                     help="train = headline tokens/s/chip (default); "
                          "collective = host-collective backend sweep "
                          "(slow, writes BENCH_collective.json); "
@@ -1379,7 +1535,10 @@ if __name__ == "__main__":
                          "dag = per-hop .remote() vs lazy vs compiled "
                          "graph dispatch (writes BENCH_dag.json); "
                          "memory = attribution overhead on the put/get "
-                         "hot path (merges into BENCH_telemetry.json)")
+                         "hot path (merges into BENCH_telemetry.json); "
+                         "train_elastic = self-healing gang fault cost: "
+                         "kill/resume recovery + straggler demotion "
+                         "(writes BENCH_train_elastic.json)")
     ns = ap.parse_args()
     if ns.bench == "collective":
         run_collective_bench()
@@ -1395,5 +1554,7 @@ if __name__ == "__main__":
         run_dag_bench()
     elif ns.bench == "memory":
         run_memory_bench()
+    elif ns.bench == "train_elastic":
+        run_train_elastic_bench()
     else:
         main()

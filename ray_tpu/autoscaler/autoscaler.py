@@ -51,15 +51,19 @@ class StandardAutoscaler:
         """One reconcile step; returns actions taken (ref: autoscaler.py
         StandardAutoscaler.update)."""
         load = self.gcs_call("get_load")
-        actions = {"launched": [], "terminated": []}
+        actions = {"launched": [], "terminated": [], "gang_demand": []}
         n_alive = len(self.provider.non_terminated_nodes())
 
-        # scale up on unmet demand
+        # scale up on unmet demand (driver pick_node misses, PENDING
+        # placement-group bundles, nodelet infeasible queues, and elastic
+        # gang shortfalls — the "gang" tag attributes those launches)
         wanted_types: List[str] = []
         for d in load["unmet_demand"]:
             t = self._pick_type(d["resources"])
             if t:
                 wanted_types.append(t)
+            if d.get("gang") and d["gang"] not in actions["gang_demand"]:
+                actions["gang_demand"].append(d["gang"])
         if not wanted_types and any(v > 0 for v in
                                     load["pending_leases"].values()):
             wanted_types.append(next(iter(self.node_types)))

@@ -270,7 +270,11 @@ def cmd_doctor(args):
                    all(v == 0 for v in drops.values()),
                    ", ".join(f"{k}={int(v)}" for k, v in drops.items())))
 
-    recent = report.get("events", [])
+    events = report.get("events", [])
+    # remediation events are the health plane ACTING (elastic training
+    # quarantine/refill/grow) — context below, not a failed check
+    recent = [e for e in events if e.get("kind") in ("stall", "straggler")]
+    remediations = [e for e in events if e.get("kind") == "remediation"]
     checks.append(("no recent stall/straggler events", not recent,
                    f"{len(recent)} event(s)"
                    + ("" if not recent else ": " + "; ".join(
@@ -295,6 +299,15 @@ def cmd_doctor(args):
     for name, ok, detail in checks:
         print(f"[{'ok' if ok else 'FAIL'}] {name}: {detail}")
         failed += 0 if ok else 1
+
+    if remediations:
+        print(f"remediations: {len(remediations)} self-healing action(s)")
+        for e in remediations[-3:]:
+            ctx = e.get("context") or {}
+            print(f"  {e.get('component', '?')}: {ctx.get('action', '?')} "
+                  f"world {ctx.get('world_before', '?')}->"
+                  f"{ctx.get('world_after', '?')} "
+                  f"suspects={ctx.get('suspects') or {}}")
 
     if mem:
         total = sum((mem.get("subsystem_bytes") or {}).values())

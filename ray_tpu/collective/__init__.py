@@ -30,10 +30,13 @@ from ray_tpu.collective.api import (GroupClient, allgather, allgather_async,
                                     barrier_async, broadcast, broadcast_async,
                                     coordinator_stats,
                                     destroy_collective_group,
+                                    generation_name,
                                     get_collective_group_size,
                                     get_group_topology, get_rank, group_stats,
                                     init_collective_group, reducescatter,
-                                    reducescatter_async, reset_transfer_stats,
+                                    reducescatter_async,
+                                    reform_collective_group,
+                                    reset_transfer_stats,
                                     transfer_stats)
 from ray_tpu.collective.errors import CollectiveError, CollectiveTimeoutError
 from ray_tpu.collective.registry import (available_backends,
@@ -42,6 +45,7 @@ from ray_tpu.collective.topology import Topology
 
 __all__ = [
     "init_collective_group", "destroy_collective_group",
+    "reform_collective_group", "generation_name",
     "allreduce", "allgather", "broadcast", "reducescatter", "barrier",
     "allreduce_async", "allgather_async", "broadcast_async",
     "reducescatter_async", "barrier_async",

@@ -311,6 +311,27 @@ def destroy_collective_group(group_name: str = "default") -> None:
             pass
 
 
+def generation_name(group_name: str, generation: int) -> str:
+    """The name incarnation `generation` of a logical group uses for its
+    helper actors. Group membership is static — a resize means a NEW
+    group — so elastic rebuilds join `name@g<N>` instead of racing the
+    previous incarnation's coordinator/mailbox actors on `name`."""
+    return group_name if generation <= 0 else f"{group_name}@g{generation}"
+
+
+def reform_collective_group(group_name: str, generation: int) -> str:
+    """Re-form a logical group for a new (possibly shrunken) membership.
+
+    Driver-side half of an elastic rebuild: tear down the PREVIOUS
+    incarnation's named helper actors (its members may all be dead, so
+    the reap must not require a local client — destroy_collective_group
+    handles that) and return the generation-qualified name the new
+    members must pass to init_collective_group. Idempotent: reaping a
+    name with no actors is a no-op."""
+    destroy_collective_group(generation_name(group_name, generation - 1))
+    return generation_name(group_name, generation)
+
+
 def _group(name: str) -> GroupClient:
     key = (_ctx(), name)
     g = _groups.get(key)

@@ -192,6 +192,34 @@ class Config:
     # rank) once it passes without progress — typically long before the
     # collective's own timeout would fire.
     collective_stall_deadline_s: float = 10.0
+    # --- elastic training (ray_tpu/train/elastic.py) -------------------------
+    # Monitor beat: how often the ElasticCoordinator polls every rank for
+    # reports / liveness while a gang attempt runs.
+    elastic_poll_interval_s: float = 0.25
+    # How often the coordinator pulls the GCS health report to fold
+    # StallEvents (wedged rank, stuck collective) into suspect ranks.
+    elastic_health_poll_interval_s: float = 1.0
+    # Report-cadence straggler demotion: once every rank has filed at
+    # least elastic_straggler_min_reports reports, a rank whose
+    # inter-report EWMA exceeds elastic_straggler_k x the gang median is
+    # quarantined. (The task-level straggler_k above can't see actor
+    # loops — report cadence is the trainer-level analog.)
+    elastic_straggler_k: float = 3.0
+    elastic_straggler_min_reports: int = 4
+    # Grow path: how often a shrunken gang probes the cluster for the
+    # capacity to refill/grow toward its target world size.
+    elastic_grow_check_interval_s: float = 5.0
+    # Placement-group reservation wait used by elastic refill/grow
+    # attempts (short on purpose: a failed attempt reports gang demand
+    # and retries next probe instead of blocking the monitor).
+    elastic_reserve_timeout_s: float = 10.0
+    # Grace window before remediation kills surviving ranks: the monitor
+    # keeps polling rank 0 until one more report lands (a report entry
+    # appends only AFTER its checkpoint save commits, so one fresh
+    # report == a complete checkpoint to resume from) or this expires.
+    # Without it a death seconds into a run can kill rank 0 mid-first-
+    # save and resume from scratch.
+    elastic_drain_grace_s: float = 10.0
     # --- memory attribution plane (observability/memory.py) -----------------
     # Per-object ownership/pin/temperature records riding the batched
     # telemetry report; False strips the put/get hot-path hooks to bare

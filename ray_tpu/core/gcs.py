@@ -94,6 +94,10 @@ class GcsServer:
         self.subscribers: Dict[str, set] = defaultdict(set)  # channel -> {addr}
         self.pending_leases: Dict[NodeID, int] = {}
         self.unmet_demand: List[dict] = []  # infeasible resource asks
+        # reporter-keyed gang shortfalls (elastic training refill/grow;
+        # same reporter-keyed + staleness-aged shape as serve
+        # report_load) — folded into get_load()'s unmet_demand
+        self.gang_demand: Dict[str, dict] = {}
         self.task_events: deque = deque(maxlen=cfg.task_event_buffer_size)
         # per-edge EWMA latency/bandwidth fed by batched telemetry
         # reports (in-memory: telemetry, re-learned after failover)
@@ -263,13 +267,28 @@ class GcsServer:
 
     async def rpc_heartbeat(self, node_id: NodeID, seqno: int,
                             available: ResourceSet,
-                            pending_leases: int = 0) -> dict:
+                            pending_leases: int = 0,
+                            infeasible: Optional[List[dict]] = None) -> dict:
         # ref: ray_syncer.h versioned snapshots — stale seqnos are dropped.
         if seqno >= self.heartbeat_seq.get(node_id, -1):
             self.heartbeat_seq[node_id] = seqno
             if node_id in self.nodes:
                 self.available[node_id] = available
                 self.pending_leases[node_id] = pending_leases
+        if infeasible is not None:
+            # permanently-infeasible lease asks the nodelet queued (no
+            # node fits, no spillback target): replace this nodelet's
+            # prior rows so the autoscaler sees current state, not a
+            # history (ref: infeasible queue -> autoscaler state)
+            src = f"nodelet:{node_id.hex()}"
+            self.unmet_demand = [d for d in self.unmet_demand
+                                 if d.get("source") != src]
+            for row in infeasible:
+                self.unmet_demand.append({
+                    "resources": dict(row.get("resources") or {}),
+                    "ts": float(row.get("ts", time.time())),
+                    "source": src})
+            del self.unmet_demand[:-100]
         self.last_seen[node_id] = time.time()
         if node_id not in self.nodes:
             # Fresh GCS after restart: membership is rebuilt from the
@@ -298,16 +317,50 @@ class GcsServer:
         """Cluster load for the autoscaler (ref: LoadMetrics
         load_metrics.py:63 fed from GCS resource state)."""
         now = time.time()
+        demand = [d for d in self.unmet_demand if now - d["ts"] < 30.0]
+        # gang shortfalls (elastic training): one row per missing worker,
+        # tagged with the gang so the autoscaler can attribute the launch
+        for reporter, g in list(self.gang_demand.items()):
+            if now - g["ts"] >= 30.0:
+                del self.gang_demand[reporter]
+                continue
+            demand.extend({"resources": dict(g["resources"]), "ts": g["ts"],
+                           "gang": g["name"]}
+                          for _ in range(min(int(g["count"]), 16)))
         return {
             "pending_leases": {nid.hex(): n
                                for nid, n in self.pending_leases.items()},
-            "unmet_demand": [d for d in self.unmet_demand
-                             if now - d["ts"] < 30.0],
+            "unmet_demand": demand,
             "idle_nodes": [nid.hex() for nid, info in self.nodes.items()
                            if info.alive and self.available.get(nid) is not None
                            and self.available[nid].quantities ==
                            info.resources_total.quantities],
         }
+
+    async def rpc_report_gang_demand(self, name: str, reporter: str,
+                                     resources: Dict[str, float],
+                                     count: int) -> dict:
+        """An elastic gang (ray_tpu.train.elastic) is `count` workers
+        short of its target. Reporter-keyed with a timestamp — the same
+        idempotent, staleness-aged shape the serve controller's
+        report_load uses — so re-reports replace rather than accumulate,
+        count=0 clears, and a dead coordinator's row ages out."""
+        if count <= 0:
+            self.gang_demand.pop(reporter, None)
+        else:
+            self.gang_demand[reporter] = {
+                "name": name, "resources": dict(resources),
+                "count": int(count), "ts": time.time()}
+        return {"ok": True}
+
+    async def rpc_report_remediation(self, event: dict) -> dict:
+        """An elastic coordinator reports a remediation action (shrink,
+        refill, grow, degraded start). Folded into the health event
+        stream: timeline instant + log line via _drain_health_events,
+        visible in health_report()/`cli doctor`."""
+        self.health.observe_remediation(dict(event))
+        self._drain_health_events()
+        return {"ok": True}
 
     # ------------------------------------------------------------- scheduling
 
@@ -533,12 +586,35 @@ class GcsServer:
         self._mark_dirty()
         return {"ok": ok, "state": self.pgs[pg_id]["state"]}
 
+    def _record_pg_demand(self, pg_id: PlacementGroupID,
+                          unplaced: List[dict]) -> None:
+        """A PENDING placement group is unmet demand too (ref: the
+        autoscaler counts pending PG bundles, resource_demand_scheduler):
+        one row per unplaced bundle, replacing this pg's prior rows so
+        retries don't accumulate."""
+        tag = pg_id.hex()
+        now = time.time()
+        self.unmet_demand = [d for d in self.unmet_demand
+                             if d.get("pg") != tag]
+        for b in unplaced:
+            res = b["resources"]
+            self.unmet_demand.append({
+                "resources": dict(getattr(res, "quantities", res)),
+                "ts": now, "pg": tag})
+        del self.unmet_demand[:-100]
+
+    def _clear_pg_demand(self, pg_id: PlacementGroupID) -> None:
+        tag = pg_id.hex()
+        self.unmet_demand = [d for d in self.unmet_demand
+                             if d.get("pg") != tag]
+
     async def _try_place_pg(self, pg_id: PlacementGroupID) -> bool:
         pg = self.pgs[pg_id]
         strategy = pg["strategy"]
         unplaced = [b for b in pg["bundles"] if b["node_id"] is None]
         if not unplaced:
             pg["state"] = "CREATED"
+            self._clear_pg_demand(pg_id)
             self._wal("pgs", pg_id, pg)
             self._mark_dirty()
             return True
@@ -563,6 +639,7 @@ class GcsServer:
                                   exclude_nodes=exclude)
         if assignment is None:
             pg["state"] = "PENDING"
+            self._record_pg_demand(pg_id, unplaced)
             return False
         plan: List[Tuple[dict, NodeID]] = list(zip(unplaced, assignment))
         # Phase 1: PREPARE on each nodelet.
@@ -582,6 +659,7 @@ class GcsServer:
                     except Exception:
                         pass
                 pg["state"] = "PENDING"
+                self._record_pg_demand(pg_id, unplaced)
                 return False
             prepared.append((b, nid))
         # Phase 2: COMMIT.
@@ -593,6 +671,7 @@ class GcsServer:
                 pass
             b["node_id"] = nid
         pg["state"] = "CREATED"
+        self._clear_pg_demand(pg_id)
         # placement succeeded through PREPARE/COMMIT: the bundle->node
         # assignments are now reservations held by nodelets and MUST
         # survive a GCS crash, or restore would double-reserve elsewhere
@@ -603,6 +682,7 @@ class GcsServer:
 
     async def rpc_remove_placement_group(self, pg_id: PlacementGroupID) -> dict:
         pg = self.pgs.pop(pg_id, None)
+        self._clear_pg_demand(pg_id)
         if pg is None:
             return {"ok": False}
         self._wal("pgs", pg_id, None)

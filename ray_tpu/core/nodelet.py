@@ -119,6 +119,11 @@ class Nodelet:
         self.leases: Dict[bytes, WorkerRecord] = {}
         self.lease_resources: Dict[bytes, Tuple[ResourceSet, Optional[Tuple]]] = {}
         self.pending: deque[_PendingLease] = deque()
+        # permanently-infeasible lease asks (no node fits, no spillback
+        # target): queued here and shipped to the GCS on the next
+        # heartbeat as autoscaler-visible unmet demand (ref: the
+        # raylet's infeasible queue feeding autoscaler state)
+        self._infeasible: List[dict] = []
         # pg_id -> {bundle_index -> {"resources", "available", "committed"}}
         self.pg_bundles: Dict[PlacementGroupID, Dict[int, dict]] = {}
         self.pool = ClientPool()
@@ -200,11 +205,13 @@ class Nodelet:
         gcs = self.pool.get(self.gcs_addr)
         while not self._stopping:
             self._hb_seq += 1
+            infeasible, self._infeasible = self._infeasible, []
             try:
                 r = await gcs.call("heartbeat", node_id=self.node_id,
                                    seqno=self._hb_seq,
                                    available=self.available,
                                    pending_leases=len(self.pending),
+                                   infeasible=infeasible or None,
                                    timeout=5.0)
                 if r.get("reregister"):
                     # GCS restarted without membership (fresh or restored
@@ -214,7 +221,9 @@ class Nodelet:
                     await gcs.call("register_node", info=self._node_info,
                                    hosted=self._hosted_actors(), timeout=5.0)
             except (ConnectionLost, RemoteError, OSError):
-                pass
+                # requeue undelivered infeasible rows for the next beat
+                self._infeasible = infeasible + self._infeasible
+                del self._infeasible[:-32]
             await asyncio.sleep(period)
 
     async def _agent_loop(self):
@@ -618,6 +627,12 @@ class Nodelet:
             if target is not None and target["node_id"] != self.node_id:
                 return {"status": "spillback", "addr": target["addr"],
                         "node_id": target["node_id"]}
+            # cluster-wide infeasible: queue for the heartbeat so the
+            # autoscaler learns the shape even when the driver's
+            # pick_node path (GCS-side recording) was never involved
+            self._infeasible.append({"resources": dict(resources.quantities),
+                                     "ts": time.time()})
+            del self._infeasible[:-32]
             return {"status": "infeasible",
                     "error": f"no node can satisfy {resources.quantities}"}
         if resources.fits_in(pool):

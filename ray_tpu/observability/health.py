@@ -345,6 +345,27 @@ class HealthAggregator:
                 out.append(ev)
         return out
 
+    # --------------------------------------------------------- remediations
+
+    def observe_remediation(self, event: dict,
+                            now: Optional[float] = None) -> StallEvent:
+        """An elastic coordinator (ray_tpu.train.elastic) reports what it
+        DID about a stall/straggler/death — quarantine, shrink, refill,
+        grow. Folded into the same event stream so `cli doctor` and the
+        timeline show cause (stall) and effect (remediation) side by
+        side; kind="remediation" so doctor's stall check skips them."""
+        now = time.time() if now is None else now
+        ev = StallEvent(
+            kind="remediation",
+            component=str(event.get("component", "")),
+            worker=None, node=None, age_s=0.0, deadline_s=0.0,
+            context={k: v for k, v in event.items()
+                     if k not in ("kind", "component", "ts")},
+            ts=float(event.get("ts", now)))
+        self.events.append(ev)
+        self._fresh.append(ev)
+        return ev
+
     # ------------------------------------------------------------ reporting
 
     def report(self, now: Optional[float] = None) -> dict:

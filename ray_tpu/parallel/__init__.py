@@ -17,6 +17,10 @@ bootstrap; XLA emits the psum/all-gather/reduce-scatter/ppermute over ICI.
 from ray_tpu.parallel.mesh import (DCNSpec, MeshSpec,
                                    build_hybrid_mesh, build_mesh,
                                    local_mesh)
+from ray_tpu.parallel.presets import (PRESETS, ParallelPreset, default_mesh,
+                                      default_rules, get_preset,
+                                      rebind_default_mesh, set_default_mesh,
+                                      sharded_jit)
 from ray_tpu.parallel.sharding import (ShardingRules, logical_to_mesh,
                                        shard_params, named_sharding)
 
@@ -24,4 +28,7 @@ __all__ = [
     "MeshSpec", "build_mesh", "local_mesh", "ShardingRules",
     "DCNSpec", "build_hybrid_mesh",
     "logical_to_mesh", "shard_params", "named_sharding",
+    "ParallelPreset", "PRESETS", "get_preset", "sharded_jit",
+    "set_default_mesh", "default_mesh", "default_rules",
+    "rebind_default_mesh",
 ]

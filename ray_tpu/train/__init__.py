@@ -25,8 +25,8 @@ separate trainer classes.
 from ray_tpu.train.backend import (Backend, JaxBackend, TensorflowBackend,
                                    TorchBackend, prepare_data_loader,
                                    prepare_model)
-from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
-                                  ScalingConfig)
+from ray_tpu.train.config import (CheckpointConfig, ElasticConfig,
+                                  FailureConfig, RunConfig, ScalingConfig)
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.trainer import (JaxTrainer, Result, TensorflowTrainer,
                                    TorchTrainer)
@@ -42,7 +42,7 @@ from ray_tpu.train import session
 
 __all__ = [
     "JaxTrainer", "TorchTrainer", "TensorflowTrainer", "Result",
-    "ScalingConfig", "RunConfig",
+    "ScalingConfig", "RunConfig", "ElasticConfig",
     "FailureConfig", "CheckpointConfig", "Checkpoint", "session",
     "Predictor", "JaxPredictor", "BatchPredictor", "TorchPredictor",
     "TransformersPredictor",

@@ -229,6 +229,15 @@ class Checkpoint:
     def exists(self) -> bool:
         return os.path.isdir(self.path) and bool(os.listdir(self.path))
 
+    def saved_process_count(self) -> Optional[int]:
+        """jax.process_count() recorded at save time (None: unreadable).
+        An elastic resume compares this with the NEW world size — a
+        multi-process orbax save restored at a different process count
+        restores through abstract_state resharding, which is worth a
+        remediation-event note for the operator timeline."""
+        self._ensure_local()
+        return _saved_procs(self.path)
+
     def _ensure_local(self) -> None:
         """Download from the URI when the local copy is absent or partial
         (a checkpoint pickled to a worker on another node, or a staging

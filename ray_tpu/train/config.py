@@ -15,6 +15,59 @@ from ray_tpu.parallel.mesh import MeshSpec
 
 
 @dataclass
+class ElasticConfig:
+    """Self-healing gang policy consumed by `ray_tpu.train.elastic`.
+
+    Setting `ScalingConfig.elastic` turns `fit()` into a remediation
+    loop: suspect ranks (death, CollectiveError suspects, health-plane
+    stalls, report-cadence stragglers) are quarantined, the gang shrinks
+    or re-fills between `min_workers` and the target, collective groups
+    re-form on a generation-suffixed name, the default mesh rebinds, and
+    training resumes from the latest checkpoint — no operator in the
+    loop. The reverse direction reports unmet gang demand to the GCS (the
+    same `report_load` shape the serve controller uses) and grows the
+    gang back toward the target when capacity appears."""
+
+    # Smallest world size the run may continue at. Below this the run
+    # fails instead of limping.
+    min_workers: int = 1
+    # Ceiling for the grow path; None = ScalingConfig.num_workers (the
+    # target). Growing past the original request needs an explicit cap.
+    max_workers: Optional[int] = None
+    # Refill quarantined/dead slots back toward the target on the next
+    # rebuild (False = run shrunken until capacity-probe growth, if any).
+    refill: bool = True
+    # Probe for capacity and grow a shrunken gang back toward the target
+    # mid-run (requires a checkpoint to restart from, or zero progress).
+    grow: bool = True
+    # Demote ranks whose report cadence lags the gang (see the
+    # elastic_straggler_* Config knobs); False = only deaths/stalls/
+    # collective suspects trigger remediation.
+    quarantine_stragglers: bool = True
+    # Give up after this many remediations (death spiral guard).
+    max_remediations: int = 8
+    # Per-rank report-progress beacon deadline override (None = the
+    # session default, 600s). Health-plane stall detection for the gang
+    # fires after this long without a session.report() on some rank.
+    step_deadline_s: Optional[float] = None
+    # Bring up a gang-wide host collective group each generation and
+    # expose its (generation-suffixed) name via
+    # session.get_collective_group(); re-formed on every rebuild.
+    host_collective: bool = True
+    # Per-run overrides of the cluster elastic_* Config knobs (None =
+    # the cluster default): monitor beat, health-plane poll cadence,
+    # straggler demotion threshold/warmup, grow probe cadence, and the
+    # placement-group wait for elastic reservations.
+    poll_interval_s: Optional[float] = None
+    health_poll_interval_s: Optional[float] = None
+    straggler_k: Optional[float] = None
+    straggler_min_reports: Optional[int] = None
+    grow_check_interval_s: Optional[float] = None
+    reserve_timeout_s: Optional[float] = None
+    drain_grace_s: Optional[float] = None
+
+
+@dataclass
 class ScalingConfig:
     num_workers: int = 1                  # host processes (1 per TPU VM host)
     chips_per_worker: Optional[int] = None  # None => all local chips
@@ -22,6 +75,9 @@ class ScalingConfig:
     rules: str = "fsdp"                   # ShardingRules preset name
     use_tpu: bool = True
     resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    # Self-healing gang policy; None = legacy fixed-size semantics (any
+    # failure restarts the whole gang via FailureConfig.max_failures).
+    elastic: Optional[ElasticConfig] = None
 
     def worker_resources(self) -> Dict[str, float]:
         r = dict(self.resources_per_worker)

@@ -25,7 +25,8 @@ class TrainContext:
     def __init__(self, *, world_rank: int, world_size: int, config: dict,
                  run_dir: str, scaling, checkpoint: Optional[Checkpoint],
                  datasets: Optional[Dict[str, Any]] = None,
-                 num_to_keep: Optional[int] = None):
+                 num_to_keep: Optional[int] = None,
+                 elastic_meta: Optional[dict] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.config = config
@@ -33,6 +34,10 @@ class TrainContext:
         self.scaling = scaling
         self.start_checkpoint = checkpoint
         self.datasets = datasets or {}
+        # Elastic gang metadata (ray_tpu/train/elastic.py): run tag for
+        # health-event attribution, the generation-suffixed host
+        # collective group name, and the per-rank report beacon deadline.
+        self.elastic_meta = elastic_meta or {}
         self.reports: List[dict] = []
         self.report_lock = threading.Lock()
         self.latest_checkpoint: Optional[Checkpoint] = checkpoint
@@ -49,6 +54,11 @@ class TrainContext:
 _ctx: Optional[TrainContext] = None
 
 
+def _step_deadline(ctx: TrainContext) -> float:
+    dl = ctx.elastic_meta.get("step_deadline_s")
+    return float(dl) if dl else _STEP_DEADLINE_S
+
+
 def _set_context(ctx: Optional[TrainContext]):
     global _ctx
     if ctx is None and _ctx is not None:
@@ -57,9 +67,12 @@ def _set_context(ctx: Optional[TrainContext]):
     if ctx is not None:
         # armed for the whole run: a rank that stops reporting past the
         # deadline (wedged collective, dead peer mid-allreduce) flags as
-        # a StallEvent naming the rank
-        _health.beacon(f"train:r{ctx.world_rank}", _STEP_DEADLINE_S).arm(
-            rank=ctx.world_rank, world=ctx.world_size)
+        # a StallEvent naming the rank. The run tag in the context lets
+        # an ElasticCoordinator attribute the event to ITS gang.
+        _health.beacon(f"train:r{ctx.world_rank}",
+                       _step_deadline(ctx)).arm(
+            rank=ctx.world_rank, world=ctx.world_size,
+            run=ctx.elastic_meta.get("run_tag", ""))
 
 
 def get_context() -> TrainContext:
@@ -144,7 +157,7 @@ def report(metrics: Dict[str, Any], *, state: Any = None) -> None:
         entry["_checkpoint"] = ckpt_path
     with ctx.report_lock:
         ctx.reports.append(entry)
-    _health.beacon(f"train:r{ctx.world_rank}", _STEP_DEADLINE_S).tick()
+    _health.beacon(f"train:r{ctx.world_rank}", _step_deadline(ctx)).tick()
 
 
 def get_checkpoint() -> Optional[Checkpoint]:
@@ -162,14 +175,32 @@ def get_dataset_shard(name: str = "train"):
 
 
 def get_mesh():
-    """The worker's device mesh per ScalingConfig (cached)."""
+    """The worker's device mesh per ScalingConfig (cached).
+
+    Also binds the mesh (+ the scaling rules) as the process-default for
+    `ray_tpu.parallel.presets.sharded_jit` — a function decorated with
+    in/out specs resolves its mesh here at call time, so an elastic
+    rebuild re-meshes every decorated step by re-running setup, with no
+    per-call-site rewiring."""
     ctx = get_context()
     if ctx._mesh is None:
+        from ray_tpu.parallel import presets
         from ray_tpu.parallel.mesh import MeshSpec, build_mesh
 
         spec = ctx.scaling.mesh or MeshSpec(dp=-1)
         ctx._mesh = build_mesh(spec)
+        presets.set_default_mesh(ctx._mesh, rules=get_rules(), spec=spec)
     return ctx._mesh
+
+
+def get_collective_group() -> Optional[str]:
+    """The gang-wide host collective group's CURRENT name, or None.
+
+    Elastic gangs re-form the group under a generation-suffixed name on
+    every rebuild (membership is static per incarnation); user loops
+    must route collective.* calls through this accessor rather than a
+    hard-coded name so they survive a remediation."""
+    return get_context().elastic_meta.get("collective_group")
 
 
 def get_rules():

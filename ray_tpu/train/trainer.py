@@ -29,6 +29,10 @@ class Result:
     metrics_history: List[dict] = field(default_factory=list)
     checkpoint: Optional[Checkpoint] = None
     error: Optional[str] = None
+    # remediation audit trail when ScalingConfig.elastic drove the run
+    # (run_tag, world size per generation, remediation events); None for
+    # fixed-size runs — see ray_tpu/train/elastic.py
+    elastic: Optional[Dict[str, Any]] = None
 
     @property
     def ok(self) -> bool:
@@ -71,6 +75,12 @@ class JaxTrainer:
         return path
 
     def fit(self) -> Result:
+        if self.scaling.elastic is not None:
+            # self-healing gang: health-plane-driven shrink/refill/grow
+            # state machine instead of the whole-group retry loop below
+            from ray_tpu.train.elastic import ElasticCoordinator
+
+            return ElasticCoordinator(self).fit()
         run_dir = self._run_dir()
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0

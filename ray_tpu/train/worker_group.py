@@ -35,7 +35,8 @@ class TrainWorker:
 
     def setup(self, config: dict, run_dir: str, scaling, checkpoint,
               datasets, coordinator: Optional[str] = None,
-              num_to_keep=None, backend=None) -> bool:
+              num_to_keep=None, backend=None,
+              elastic_meta: Optional[dict] = None) -> bool:
         # Collective bootstrap is a pluggable Backend hook
         # (ref: backend_executor.py Backend.on_start); default JaxBackend.
         from ray_tpu.train.backend import JaxBackend
@@ -51,7 +52,8 @@ class TrainWorker:
         self.ctx = TrainContext(
             world_rank=self.rank, world_size=self.world_size, config=config,
             run_dir=run_dir, scaling=scaling, checkpoint=checkpoint,
-            datasets=datasets, num_to_keep=num_to_keep)
+            datasets=datasets, num_to_keep=num_to_keep,
+            elastic_meta=elastic_meta)
         _set_context(self.ctx)
         return True
 
@@ -146,35 +148,54 @@ def _accepts_arg(fn) -> bool:
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
-                 placement_strategy: str = "PACK"):
+                 placement_strategy: str = "PACK",
+                 pg_timeout_s: float = 60.0):
         self.num_workers = num_workers
         self.resources = resources_per_worker
         self.placement_strategy = placement_strategy
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
         self.pg = placement_group(bundles, strategy=placement_strategy)
-        if not self.pg.ready(timeout=60):
+        if not self.pg.ready(timeout=pg_timeout_s):
             remove_placement_group(self.pg)
             raise ray_tpu.exceptions.PlacementGroupUnavailableError(
                 f"could not reserve {num_workers} x {resources_per_worker}")
         self._extra_pgs: List[Any] = []
         self._worker_pg: Dict[Any, Any] = {}   # worker -> its pg
+        # worker index -> (pg, bundle_index); parallel to self.workers so
+        # elastic respawn/refill can reuse the exact reservation a dead
+        # worker held (ref: BackendExecutor keeps bundle->worker maps)
+        self._placements: List[tuple] = []
+        # freed reservations a future add_workers may reuse, and
+        # quarantined ones it must NOT (suspect rank's slot held hostage
+        # so a refill can't land back on the flapping host/process)
+        self._free_bundles: List[tuple] = []
+        self._quarantined: set = set()          # {(id(pg), bundle_index)}
         self.workers = []
         for rank in range(num_workers):
-            w = TrainWorker.options(
-                num_cpus=0,
-                resources={k: v for k, v in resources_per_worker.items()},
-                max_concurrency=2,
-                scheduling_strategy=PlacementGroupSchedulingStrategy(
-                    placement_group=self.pg,
-                    placement_group_bundle_index=rank),
-            ).remote(rank, num_workers)
-            self.workers.append(w)
-            self._worker_pg[w] = self.pg
+            self.workers.append(self._spawn(self.pg, rank, rank, num_workers))
+            self._placements.append((self.pg, rank))
+
+    def _spawn(self, pg, bundle_index: int, rank: int, world: int):
+        w = TrainWorker.options(
+            num_cpus=0,
+            resources={k: v for k, v in self.resources.items()},
+            max_concurrency=2,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_bundle_index=bundle_index),
+        ).remote(rank, world)
+        self._worker_pg[w] = pg
+        return w
 
     def broadcast(self, method: str, *args, **kwargs):
         refs = [getattr(w, method).remote(*args, **kwargs)
                 for w in self.workers]
         return ray_tpu.get(refs)
+
+    @property
+    def quarantined_count(self) -> int:
+        """Reserved-but-unusable bundles held by quarantined ranks."""
+        return len(self._quarantined)
 
     def init_host_collective(self, group_name: str = "train",
                              backend: str = "auto",
@@ -195,12 +216,17 @@ class WorkerGroup:
     # ---- elasticity (ref: worker_group.py:318 remove_workers /
     #      :333 add_workers; BackendExecutor resizes then re-ranks) ------
 
-    def remove_workers(self, indices: List[int]) -> None:
+    def remove_workers(self, indices: List[int],
+                       quarantine: bool = False) -> None:
         """Drop workers by index (dead or drained); ranks are refreshed
-        across the survivors. A supplemental PG whose workers are all
-        gone is removed so its bundles return to the cluster; bundles of
-        the ORIGINAL PG stay reserved until shutdown (placement groups
-        cannot shrink — same contract as the reference)."""
+        across the survivors. A freed bundle goes back on the reuse list
+        unless `quarantine`d — a quarantined slot stays RESERVED but
+        unusable, so an elastic refill cannot land a replacement on the
+        suspect host/process. A supplemental PG with no live or
+        quarantined workers is removed so its bundles return to the
+        cluster; bundles of the ORIGINAL PG stay reserved until shutdown
+        (placement groups cannot shrink — same contract as the
+        reference)."""
         for i in sorted(set(indices), reverse=True):
             w = self.workers.pop(i)
             try:
@@ -208,10 +234,18 @@ class WorkerGroup:
             except Exception:
                 pass
             self._worker_pg.pop(w, None)
+            pg, bundle = self._placements.pop(i)
+            if quarantine:
+                self._quarantined.add((id(pg), bundle))
+            else:
+                self._free_bundles.append((pg, bundle))
         live_pgs = set(map(id, self._worker_pg.values()))
+        held_pgs = live_pgs | {pid for (pid, _b) in self._quarantined}
         for pg in list(self._extra_pgs):
-            if id(pg) not in live_pgs:
+            if id(pg) not in held_pgs:
                 self._extra_pgs.remove(pg)
+                self._free_bundles = [
+                    (p, b) for (p, b) in self._free_bundles if p is not pg]
                 try:
                     remove_placement_group(pg)
                 except Exception:
@@ -219,31 +253,65 @@ class WorkerGroup:
         self.num_workers = len(self.workers)
         self._reassign_ranks()
 
-    def add_workers(self, n: int, timeout: float = 60.0) -> None:
-        """Grow the gang by n workers. New workers reserve a supplemental
-        placement group with the group's original strategy (the original
-        PG's bundle count is fixed)."""
-        bundles = [dict(self.resources) for _ in range(n)]
-        pg = placement_group(bundles, strategy=self.placement_strategy)
-        if not pg.ready(timeout=timeout):
-            remove_placement_group(pg)
-            raise ray_tpu.exceptions.PlacementGroupUnavailableError(
-                f"could not reserve {n} x {self.resources} to grow the "
-                "worker group")
-        self._extra_pgs.append(pg)
+    def respawn_workers(self, indices: Optional[List[int]] = None) -> None:
+        """Replace workers with FRESH actor processes in the same
+        bundles. A user loop thread cannot be preempted in place, and a
+        surviving rank's jax/collective state is bound to the dead
+        topology — replacing the process is the only reliable reset, and
+        its reservation is already held so no scheduling round-trip."""
+        idxs = list(range(len(self.workers))) if indices is None else indices
+        world = len(self.workers)
+        for i in idxs:
+            old = self.workers[i]
+            try:
+                ray_tpu.kill(old)
+            except Exception:
+                pass
+            self._worker_pg.pop(old, None)
+            pg, bundle = self._placements[i]
+            self.workers[i] = self._spawn(pg, bundle, i, world)
+        self._reassign_ranks()
+
+    def add_workers(self, n: int, timeout: float = 60.0,
+                    partial: bool = False) -> int:
+        """Grow the gang by n workers, reusing freed (non-quarantined)
+        bundles first; the remainder reserves a supplemental placement
+        group with the group's original strategy (the original PG's
+        bundle count is fixed). With `partial`, a failed supplemental
+        reservation adds however many workers the freed bundles covered
+        (possibly 0) instead of raising — the elastic refill path, which
+        reports the shortfall as gang demand and retries later. Returns
+        the number of workers actually added."""
+        placements: List[tuple] = []
+        while self._free_bundles and len(placements) < n:
+            placements.append(self._free_bundles.pop())
+        rest = n - len(placements)
+        pg = None
+        if rest > 0:
+            bundles = [dict(self.resources) for _ in range(rest)]
+            pg = placement_group(bundles, strategy=self.placement_strategy)
+            if not pg.ready(timeout=timeout):
+                try:
+                    remove_placement_group(pg)
+                except Exception:
+                    pass
+                if not partial:
+                    self._free_bundles.extend(placements)
+                    raise ray_tpu.exceptions.PlacementGroupUnavailableError(
+                        f"could not reserve {rest} x {self.resources} to "
+                        "grow the worker group")
+                pg = None
+            else:
+                self._extra_pgs.append(pg)
+                placements.extend((pg, i) for i in range(rest))
         base = len(self.workers)
-        for i in range(n):
-            w = TrainWorker.options(
-                num_cpus=0,
-                resources={k: v for k, v in self.resources.items()},
-                max_concurrency=2,
-                scheduling_strategy=PlacementGroupSchedulingStrategy(
-                    placement_group=pg, placement_group_bundle_index=i),
-            ).remote(base + i, base + n)
-            self.workers.append(w)
-            self._worker_pg[w] = pg
+        world = base + len(placements)
+        for i, (p, b) in enumerate(placements):
+            self.workers.append(self._spawn(p, b, base + i, world))
+            self._placements.append((p, b))
         self.num_workers = len(self.workers)
         self._reassign_ranks()
+        return len(placements)
 
     def _reassign_ranks(self):
         n = len(self.workers)
