@@ -404,6 +404,35 @@ def cmd_lint(args):
     sys.exit(lint_main(rest))
 
 
+def cmd_chaos(args):
+    """Seeded fault-injection scenario: spin up an ephemeral cluster,
+    run the canonical task+actor workload under a FaultPlan, and check
+    the invariants (typed-within-deadline, exactly-once side effects,
+    clean pin/resource accounting). Same seed ⟹ same injected faults."""
+    from ray_tpu.devtools import chaos
+
+    if args.plan:
+        with open(args.plan) as f:
+            plan = chaos.FaultPlan.from_json(f.read())
+        if args.seed is not None:
+            plan.seed = args.seed
+    else:
+        plan = chaos.canonical_plan(args.seed or 0)
+    report = chaos.run_scenario(plan, num_nodes=args.nodes,
+                                tasks=args.tasks, actors=args.actors,
+                                calls=args.calls)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(f"seed={report['seed']} rules={report['rules']} "
+              f"injected={report['injected_driver_side']} "
+              f"elapsed={report['elapsed_s']}s")
+        for v in report["violations"]:
+            print(f"  VIOLATION: {v}")
+        print("OK" if report["ok"] else "FAILED")
+    sys.exit(0 if report["ok"] else 1)
+
+
 def cmd_dashboard(args):
     """Serve the HTTP dashboard against a running cluster
     (ref: dashboard/head.py)."""
@@ -537,6 +566,22 @@ def main():
                    help="passed through to python -m ray_tpu.devtools.lint "
                         "(paths, --changed-only, --fail-on, --json, ...)")
     s.set_defaults(fn=cmd_lint)
+
+    s = sub.add_parser("chaos", help="run the seeded fault-injection "
+                       "scenario (devtools.chaos) on an ephemeral cluster")
+    s.add_argument("--seed", type=int, default=None,
+                   help="FaultPlan seed (same seed ⟹ same fault sequence)")
+    s.add_argument("--plan", default=None,
+                   help="FaultPlan JSON file (default: the canonical "
+                        "drop/reorder/duplicate/black-hole mix)")
+    s.add_argument("--nodes", type=int, default=1)
+    s.add_argument("--tasks", type=int, default=8)
+    s.add_argument("--actors", type=int, default=2)
+    s.add_argument("--calls", type=int, default=4)
+    s.add_argument("--json", action="store_true",
+                   help="print the full report (incl. the injected-fault "
+                        "sequence) as JSON")
+    s.set_defaults(fn=cmd_chaos)
 
     # cluster launcher (ref: scripts.py:1238,1314,1398,1696 up/down/
     # attach/exec over the NodeProvider API)

@@ -103,6 +103,25 @@ class Config:
     gcs_reconnect_timeout_s: float = 30.0
     # --- timeouts -----------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
+    # Default transport deadline for every control-plane RpcClient.call()
+    # that does not pass its own: a gray-failed peer (black-holed link,
+    # wedged handler) surfaces as a typed RpcTimeout instead of hanging
+    # the caller forever. Long-running data-plane calls (push_task) opt
+    # out with an explicit, lint-allowlisted timeout=None.
+    rpc_call_timeout_s: float = 60.0
+    # Application-level keepalive: each RpcClient pings its server every
+    # interval; a connection that stays rx-silent past the timeout is
+    # aborted, converting a black-holed link into ConnectionLost (TCP
+    # alone buffers writes for minutes before noticing — the gray
+    # failure mode of Huang et al. HotOS'17). 0 disables pinging.
+    rpc_keepalive_interval_s: float = 5.0
+    rpc_keepalive_timeout_s: float = 20.0
+    # Serialized devtools.chaos.FaultPlan (JSON) — when non-empty, every
+    # process in the session installs the same seeded fault-injection
+    # interposer into its transport at startup (the plan inherits through
+    # the spawned-process --config chain, so one plan governs the whole
+    # cluster and one seed reproduces one fault sequence).
+    chaos_plan: str = ""
     get_timeout_warn_s: float = 10.0
     # --- workers ------------------------------------------------------------
     worker_start_timeout_s: float = 60.0

@@ -82,6 +82,12 @@ class ActorRecord:
 class GcsServer:
     def __init__(self, cfg: Config):
         self.cfg = cfg
+        # deadlines/keepalive knobs + optional chaos plan bind from the
+        # inherited Config so the whole cluster shares one failure model
+        from ray_tpu.core import rpc as _rpc
+        from ray_tpu.devtools import chaos as _chaos
+        _rpc.configure(cfg)
+        _chaos.maybe_install(cfg, role="gcs")
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.available: Dict[NodeID, ResourceSet] = {}
         self.heartbeat_seq: Dict[NodeID, int] = {}
@@ -98,6 +104,9 @@ class GcsServer:
         # same reporter-keyed + staleness-aged shape as serve
         # report_load) — folded into get_load()'s unmet_demand
         self.gang_demand: Dict[str, dict] = {}
+        # reporter -> highest seq applied (monotonic fence against
+        # reordered/duplicated stale gang-demand reports)
+        self._gang_demand_seq: Dict[str, int] = {}
         self.task_events: deque = deque(maxlen=cfg.task_event_buffer_size)
         # per-edge EWMA latency/bandwidth fed by batched telemetry
         # reports (in-memory: telemetry, re-learned after failover)
@@ -250,6 +259,8 @@ class GcsServer:
         self.nodes[info.node_id] = info
         self.available[info.node_id] = info.resources_total.copy()
         self.last_seen[info.node_id] = time.time()
+        from ray_tpu.devtools.chaos import note_peer
+        note_peer(tuple(info.nodelet_addr), "nodelet")
         # A rejoining nodelet reports the actors it hosts; adopt them so a
         # restarted GCS doesn't double-create actors whose creation landed
         # after the last snapshot (ref: failover reconstruction).
@@ -339,12 +350,24 @@ class GcsServer:
 
     async def rpc_report_gang_demand(self, name: str, reporter: str,
                                      resources: Dict[str, float],
-                                     count: int) -> dict:
+                                     count: int,
+                                     seq: Optional[int] = None) -> dict:
         """An elastic gang (ray_tpu.train.elastic) is `count` workers
         short of its target. Reporter-keyed with a timestamp — the same
         idempotent, staleness-aged shape the serve controller's
         report_load uses — so re-reports replace rather than accumulate,
-        count=0 clears, and a dead coordinator's row ages out."""
+        count=0 clears, and a dead coordinator's row ages out.
+
+        ``seq`` is the reporter's monotonic sequence number: a delayed
+        or duplicated stale report (reordered under partition, or
+        chaos-injected) must not overwrite — or resurrect after a
+        count=0 clear — a newer row. seq=None keeps the old
+        last-writer-wins semantics for legacy reporters."""
+        if seq is not None:
+            last = self._gang_demand_seq.get(reporter, -1)
+            if seq <= last:
+                return {"ok": True, "stale": True}
+            self._gang_demand_seq[reporter] = seq
         if count <= 0:
             self.gang_demand.pop(reporter, None)
         else:
@@ -422,6 +445,12 @@ class GcsServer:
         (ref: gcs_actor_scheduler.h lease-based actor scheduling)."""
         spec = rec.spec
         deadline = time.time() + self.cfg.worker_lease_timeout_s * 10
+        # Stable per-incarnation idempotency token: every retry of THIS
+        # creation attempt (e.g. after a dropped response) carries the
+        # same token, so the nodelet replays the recorded placement
+        # instead of leasing a second worker and running __init__ twice.
+        # A restart bumps num_restarts and legitimately creates anew.
+        idem = f"{rec.actor_id.hex()}:{rec.num_restarts}"
         while not self._stopping:
             target = await self._pick_for_spec(spec)
             if target is None:
@@ -435,7 +464,12 @@ class GcsServer:
             nid = target["node_id"]
             client = self.pool.get(tuple(target["addr"]))
             try:
-                r = await client.call("create_actor", spec=spec)
+                # Creation waits on a worker lease + __init__, so it gets
+                # its own bound rather than the default rpc deadline.
+                r = await client.call(
+                    "create_actor", spec=spec, idem=idem,
+                    timeout=self.cfg.worker_start_timeout_s
+                    + self.cfg.worker_lease_timeout_s + 10.0)
             except (ConnectionLost, RemoteError, OSError) as e:
                 logger.warning("actor create on %s failed: %s", nid.hex()[:8], e)
                 await asyncio.sleep(0.2)
@@ -520,7 +554,8 @@ class GcsServer:
             try:
                 # actor_id lets a lane-host nodelet kill ONLY this lane
                 await client.call("kill_worker", worker_id=rec.worker_id,
-                                  actor_id=actor_id, reason="ray_tpu.kill")
+                                  actor_id=actor_id, reason="ray_tpu.kill",
+                                  timeout=10.0)
             except (ConnectionLost, RemoteError, OSError):
                 pass
         if no_restart:
@@ -647,15 +682,18 @@ class GcsServer:
         for b, nid in plan:
             client = self.pool.get(self.nodes[nid].nodelet_addr)
             try:
+                # tight bound: a gray nodelet must not stall the 2PC
+                # prepare loop for the default deadline per bundle
                 r = await client.call("pg_prepare", pg_id=pg_id, bundle_index=b["index"],
-                                      resources=b["resources"])
+                                      resources=b["resources"], timeout=10.0)
             except (ConnectionLost, RemoteError, OSError):
                 r = {"ok": False}
             if not r.get("ok"):
                 for pb, pnid in prepared:  # rollback
                     try:
                         await self.pool.get(self.nodes[pnid].nodelet_addr).call(
-                            "pg_return", pg_id=pg_id, bundle_index=pb["index"])
+                            "pg_return", pg_id=pg_id, bundle_index=pb["index"],
+                            timeout=10.0)
                     except Exception:
                         pass
                 pg["state"] = "PENDING"
@@ -666,7 +704,8 @@ class GcsServer:
         for b, nid in prepared:
             try:
                 await self.pool.get(self.nodes[nid].nodelet_addr).call(
-                    "pg_commit", pg_id=pg_id, bundle_index=b["index"])
+                    "pg_commit", pg_id=pg_id, bundle_index=b["index"],
+                    timeout=10.0)
             except (ConnectionLost, RemoteError, OSError):
                 pass
             b["node_id"] = nid
@@ -692,7 +731,8 @@ class GcsServer:
             if nid is not None and nid in self.nodes:
                 try:
                     await self.pool.get(self.nodes[nid].nodelet_addr).call(
-                        "pg_return", pg_id=pg_id, bundle_index=b["index"])
+                        "pg_return", pg_id=pg_id, bundle_index=b["index"],
+                        timeout=10.0)
                 except Exception:
                     pass
         return {"ok": True}
@@ -816,6 +856,13 @@ class GcsServer:
         if mem:
             self.memory.update(str(report.get("worker", "?")),
                                report.get("node"), mem)
+        susp = report.get("rpc_suspicions")
+        if susp:
+            # rpc-deadline misses reported by callers: folded into
+            # peer-suspicion health events (gray-failure evidence)
+            self.health.observe_rpc_suspicions(
+                str(report.get("worker", "?")), report.get("node"), susp)
+            self._drain_health_events()
         for ob in report.get("edges") or []:
             self.edge_model.observe(ob.get("src"), ob.get("dst"),
                                     ob.get("nbytes", 0.0),

@@ -41,6 +41,8 @@ from ray_tpu.core.memory_monitor import (KillCandidate, MemoryMonitor,
                                          pick_worker_to_kill)
 from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.core.rpc import ClientPool, ConnectionLost, RemoteError, RpcServer
+from ray_tpu.util.backoff import Backoff
+from ray_tpu.util.idempotency import IdemCache
 
 logger = logging.getLogger("ray_tpu.nodelet")
 
@@ -103,6 +105,13 @@ class Nodelet:
         self.cfg = cfg
         self.gcs_addr = gcs_addr
         self.session_dir = session_dir
+        # deadlines/keepalive knobs + optional chaos plan bind from the
+        # inherited Config so the whole cluster shares one failure model
+        from ray_tpu.core import rpc as _rpc
+        from ray_tpu.devtools import chaos as _chaos
+        _rpc.configure(cfg)
+        _chaos.maybe_install(cfg, role="nodelet")
+        _chaos.note_peer(tuple(gcs_addr), "gcs")
         self.node_id = NodeID.from_random()
         self.store_name = store_name or f"/raytpu_{self.node_id.hex()[:12]}"
         res = dict(resources) if resources else {}
@@ -149,6 +158,13 @@ class Nodelet:
         self._hb_seq = 0
         self._stopping = False
         self._lane_locks: Dict[str, asyncio.Lock] = {}
+        # Idempotency-token dedupe for the two side-effecting handlers a
+        # duplicated frame (retry after dropped response, chaos-injected
+        # duplication) would double-spend: lease grants and actor
+        # creation. Only granted/ok outcomes are replayed — see
+        # util/idempotency.py for why failures must not be.
+        self._idem_lease = IdemCache()
+        self._idem_create = IdemCache()
         self.memory_monitor = MemoryMonitor(
             cfg.memory_usage_threshold, cfg.memory_monitor_test_usage_file)
 
@@ -400,8 +416,12 @@ class Nodelet:
         # Durable best-effort: the GCS may be mid-restart; keep retrying
         # through the failover window so actor FSMs see the death
         # (ref: raylet death reports + GCS reconnect). actor_id scopes the
-        # report to ONE lane of a surviving lane-host worker.
-        deadline = time.time() + self.cfg.gcs_reconnect_timeout_s
+        # report to ONE lane of a surviving lane-host worker. Jittered
+        # exponential backoff: every worker of a dead node reports at
+        # once, and fixed sleeps would herd them against the restarting
+        # GCS in lockstep.
+        bo = Backoff(base_s=0.1, cap_s=2.0,
+                     deadline_s=time.time() + self.cfg.gcs_reconnect_timeout_s)
         while not self._stopping:
             try:
                 await self.pool.get(self.gcs_addr).call(
@@ -410,9 +430,9 @@ class Nodelet:
                     actor_id=actor_id, timeout=5.0)
                 return
             except Exception:
-                if time.time() >= deadline:
+                if bo.expired():
                     return
-                await asyncio.sleep(0.5)
+                await asyncio.sleep(bo.next_delay())
 
     async def _memory_monitor_loop(self):
         """Kill a worker when host memory crosses the threshold
@@ -453,6 +473,8 @@ class Nodelet:
         w.last_idle = time.time()
         w.ready.set()
         self._worker_idle.set()
+        from ray_tpu.devtools.chaos import note_peer
+        note_peer(w.addr, "worker")
         return {"ok": True}
 
     async def rpc_worker_blocked(self, worker_id: bytes) -> dict:
@@ -616,7 +638,24 @@ class Nodelet:
                                 grant_or_reject: bool = False,
                                 job_id: Optional[bytes] = None,
                                 retriable: bool = True,
-                                env_vars: Optional[dict] = None) -> dict:
+                                env_vars: Optional[dict] = None,
+                                idem: Optional[str] = None) -> dict:
+        """``idem``: caller-minted idempotency token. A duplicated frame
+        replays the recorded grant instead of leasing a second worker;
+        non-granted verdicts (retry/spillback/infeasible) are never
+        cached, so a genuine retry with a fresh token re-attempts."""
+        return await self._idem_lease.run(
+            idem,
+            lambda: self._request_lease(resources, pg, grant_or_reject,
+                                        job_id, retriable, env_vars),
+            cache_if=lambda r: r.get("status") == "granted")
+
+    async def _request_lease(self, resources: ResourceSet,
+                             pg: Optional[Tuple] = None,
+                             grant_or_reject: bool = False,
+                             job_id: Optional[bytes] = None,
+                             retriable: bool = True,
+                             env_vars: Optional[dict] = None) -> dict:
         pool = self._resource_pool(pg)
         if pool is None:
             return {"status": "infeasible", "error": "placement group bundle not here"}
@@ -866,10 +905,22 @@ class Nodelet:
             w.last_idle = time.time()
             self._worker_idle.set()
 
-    async def rpc_create_actor(self, spec: TaskSpec) -> dict:
+    async def rpc_create_actor(self, spec: TaskSpec,
+                               idem: Optional[str] = None) -> dict:
         """Lease a dedicated worker and run the creation task on it
         (ref: gcs_actor_scheduler leases from raylet + pushes creation).
-        Fractional-CPU actors take the lane path instead."""
+        Fractional-CPU actors take the lane path instead.
+
+        ``idem`` is the GCS's token, stable across its retries of one
+        (actor, incarnation): a retry after a dropped response replays
+        the recorded placement instead of leasing a second worker and
+        running ``__init__`` twice. Failures are not cached — the retry
+        exists to attempt creation again."""
+        return await self._idem_create.run(
+            idem, lambda: self._create_actor(spec),
+            cache_if=lambda r: r.get("ok"))
+
+    async def _create_actor(self, spec: TaskSpec) -> dict:
         if self._laneable(spec):
             return await self._create_actor_lane(spec)
         pg = None

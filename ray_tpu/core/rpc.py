@@ -9,7 +9,25 @@ per TPU VM host, tens of hosts — connection counts are small and the pickle
 frame path is faster than protobuf ser/des for numpy-bearing payloads.
 
 Frames:  [u32 len][pickle((kind, msg_id, method, payload))]
-  kind: 0 = request, 1 = response-ok, 2 = response-error, 3 = one-way
+  kind: 0 = request, 1 = response-ok, 2 = response-error, 3 = one-way,
+        4 = keepalive ping, 5 = keepalive pong
+
+Partition tolerance: TCP alone cannot distinguish a black-holed link from
+a slow peer — writes buffer locally for minutes before erroring (the gray
+failure mode of Huang et al., HotOS'17). Two defenses live here:
+
+- every ``RpcClient.call`` carries a transport deadline by default
+  (``configure()`` binds it to Config.rpc_call_timeout_s); expiry raises
+  the typed ``RpcTimeout`` and feeds a per-peer suspicion counter the
+  telemetry agent drains into the health plane.
+- each client connection runs an application-level keepalive: PING every
+  ``rpc_keepalive_interval_s``; a connection that stays rx-silent past
+  ``rpc_keepalive_timeout_s`` is aborted, converting the black hole into
+  ``ConnectionLost`` for every pending caller.
+
+The devtools.chaos interposer (``set_chaos``) sits on the four frame
+edges — client egress/ingress, server ingress/egress — so a seeded
+FaultPlan can drop/delay/duplicate/reorder/black-hole/reset any link.
 """
 
 from __future__ import annotations
@@ -22,11 +40,41 @@ import struct
 import threading
 import time
 import traceback
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _LEN = struct.Struct("<I")
-REQUEST, RESPONSE_OK, RESPONSE_ERR, ONEWAY = 0, 1, 2, 3
+REQUEST, RESPONSE_OK, RESPONSE_ERR, ONEWAY, PING, PONG = 0, 1, 2, 3, 4, 5
 MAX_FRAME = 1 << 31
+
+# Module defaults; configure(cfg) rebinds them from Config in every
+# process entrypoint (runtime/gcs/nodelet/worker). A sentinel — not None —
+# marks "caller passed nothing", because explicit timeout=None must keep
+# meaning "unbounded" for the reviewed allowlist (push_task).
+_UNSET_TIMEOUT: Any = object()
+_call_timeout_s: float = 60.0
+_keepalive_interval_s: float = 5.0
+_keepalive_timeout_s: float = 20.0
+
+# devtools.chaos.Interposer | None — consulted (never imported) here, so
+# core stays import-free of devtools.
+_chaos: Optional[Any] = None
+
+
+def configure(cfg) -> None:
+    """Bind module-level transport defaults from a core.config.Config."""
+    global _call_timeout_s, _keepalive_interval_s, _keepalive_timeout_s
+    _call_timeout_s = cfg.rpc_call_timeout_s
+    _keepalive_interval_s = cfg.rpc_keepalive_interval_s
+    _keepalive_timeout_s = cfg.rpc_keepalive_timeout_s
+
+
+def set_chaos(interposer: Optional[Any]) -> None:
+    global _chaos
+    _chaos = interposer
+
+
+def get_chaos() -> Optional[Any]:
+    return _chaos
 
 
 class RpcError(Exception):
@@ -39,6 +87,43 @@ class RemoteError(RpcError):
 
 class ConnectionLost(RpcError):
     pass
+
+
+class RpcTimeout(RpcError, asyncio.TimeoutError, TimeoutError):
+    """Transport deadline expired with no response.
+
+    Subclasses BOTH timeout spellings (pre-3.11 asyncio.TimeoutError is
+    not the builtin) so every existing wait_for/OSError-family handler
+    keeps working — retry loops that treat OSError as "peer unreachable,
+    retry" absorb timeouts the same way. Distinct from ConnectionLost
+    because the link may be fine and the *peer* gray-failed — the health
+    plane treats repeated RpcTimeouts as a peer-suspicion signal."""
+
+
+# Per-peer timeout suspicions: {(host, port, method): count}, drained by
+# the telemetry agent into the GCS health aggregator (a black-holed or
+# wedged peer shows up here long before any crash-stop signal).
+_suspicion_lock = threading.Lock()
+_suspicions: Dict[Tuple[str, int, str], int] = {}
+
+
+def _note_timeout(host: str, port: int, method: str) -> None:
+    with _suspicion_lock:
+        key = (host, port, method)
+        _suspicions[key] = _suspicions.get(key, 0) + 1
+        while len(_suspicions) > 256:
+            _suspicions.pop(next(iter(_suspicions)))
+
+
+def drain_timeout_suspicions() -> List[dict]:
+    """Pop-and-return accumulated RpcTimeout counts (telemetry agent)."""
+    with _suspicion_lock:
+        if not _suspicions:
+            return []
+        out = [{"peer": f"{h}:{p}", "method": m, "count": c}
+               for (h, p, m), c in _suspicions.items()]
+        _suspicions.clear()
+        return out
 
 
 async def _read_frame(reader: asyncio.StreamReader):
@@ -150,13 +235,48 @@ class RpcServer:
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._conns.add(writer)
+        # Sender role for chaos rule matching: the client announces it in
+        # a __hello__ oneway right after connect (only when a plan is
+        # installed); "*" until/unless one arrives.
+        conn_role = "*"
         try:
             while True:
                 try:
                     kind, msg_id, method, payload = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
-                if kind == ONEWAY:
+                if kind == ONEWAY and method == "__hello__":
+                    conn_role = payload.get("role", "*")
+                    continue
+                if kind == PING:
+                    # keepalive probe: answer inline unless an installed
+                    # fault plan black-holes this link (a dropped PONG is
+                    # exactly how a black hole converts to ConnectionLost
+                    # on the other side)
+                    if _chaos is None or _chaos.on_frame(
+                            "recv", "__ping__", PING,
+                            peer_role=conn_role).action == "pass":
+                        writer.write(_frame((PONG, msg_id, "", None)))
+                        await writer.drain()
+                    continue
+                delay_s = 0.0
+                copies = 1
+                if _chaos is not None:
+                    v = _chaos.on_frame("recv", method, kind,
+                                        peer_role=conn_role)
+                    if v.action == "drop":
+                        continue
+                    if v.action == "reset":
+                        try:
+                            writer.transport.abort()
+                        except Exception:
+                            pass
+                        return
+                    if v.action == "delay":
+                        delay_s = v.delay_s
+                    elif v.action == "duplicate":
+                        copies = 2
+                if kind == ONEWAY and not delay_s and copies == 1:
                     # inline fast path for handlers that opt in (standing
                     # channel frames): a synchronous, non-blocking handler
                     # runs right here, skipping a dispatch-task round on
@@ -168,10 +288,12 @@ class RpcServer:
                         except Exception:
                             self._stat(method)["errors"] += 1
                         continue
-                t = asyncio.get_running_loop().create_task(
-                    self._dispatch(writer, kind, msg_id, method, payload))
-                self._dispatches.add(t)
-                t.add_done_callback(self._dispatches.discard)
+                for _ in range(copies):
+                    t = asyncio.get_running_loop().create_task(
+                        self._dispatch(writer, kind, msg_id, method, payload,
+                                       conn_role=conn_role, delay_s=delay_s))
+                    self._dispatches.add(t)
+                    t.add_done_callback(self._dispatches.discard)
         finally:
             self._conns.discard(writer)
             try:
@@ -179,7 +301,12 @@ class RpcServer:
             except Exception:
                 pass
 
-    async def _dispatch(self, writer, kind, msg_id, method, payload):
+    async def _dispatch(self, writer, kind, msg_id, method, payload,
+                        conn_role: str = "*", delay_s: float = 0.0):
+        if delay_s:
+            # injected ingress delay: later frames overtake this dispatch
+            # (reordering), which is the point
+            await asyncio.sleep(delay_s)
         t0 = time.monotonic()
         known = True
         try:
@@ -201,8 +328,8 @@ class RpcServer:
             if el > s["max_s"]:
                 s["max_s"] = el
             if kind == REQUEST:
-                writer.write(_frame((RESPONSE_OK, msg_id, method, res)))
-                await writer.drain()
+                await self._send_response(
+                    writer, (RESPONSE_OK, msg_id, method, res), conn_role)
         except BaseException:
             # BaseException: a handler awaiting a cancelled executor
             # future raises CancelledError — the caller must still get a
@@ -213,11 +340,31 @@ class RpcServer:
                 self._stat(method)["errors"] += 1
             if kind == REQUEST:
                 try:
-                    writer.write(_frame(
-                        (RESPONSE_ERR, msg_id, method, traceback.format_exc())))
-                    await writer.drain()
+                    await self._send_response(
+                        writer,
+                        (RESPONSE_ERR, msg_id, method, traceback.format_exc()),
+                        conn_role)
                 except Exception:
                     pass
+
+    async def _send_response(self, writer, msg, conn_role: str):
+        """Response egress — the server-side chaos edge for reply frames."""
+        if _chaos is not None:
+            v = _chaos.on_frame("send", msg[2], msg[0], peer_role=conn_role)
+            if v.action == "drop":
+                return
+            if v.action == "reset":
+                try:
+                    writer.transport.abort()
+                except Exception:
+                    pass
+                return
+            if v.action == "delay":
+                await asyncio.sleep(v.delay_s)
+            elif v.action == "duplicate":
+                writer.write(_frame(msg))
+        writer.write(_frame(msg))
+        await writer.drain()
 
 
 class RpcClient:
@@ -231,6 +378,9 @@ class RpcClient:
         self._ids = itertools.count()
         self._conn_lock: Optional[asyncio.Lock] = None
         self._read_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._last_rx = 0.0
+        self._chaos_tasks: set = set()   # injected delayed-send tasks
         # bumps on every (re)connect — lets callers notice a silent
         # server restart (e.g. to re-register pubsub subscriptions)
         self.generation = 0
@@ -243,12 +393,72 @@ class RpcClient:
                 return
             self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
             self.generation += 1
-            self._read_task = asyncio.get_running_loop().create_task(self._read_loop())
+            self._last_rx = time.monotonic()
+            loop = asyncio.get_running_loop()
+            self._read_task = loop.create_task(self._read_loop())
+            if _chaos is not None:
+                # announce our role so the server side can match
+                # src-role rules on this connection
+                self._writer.write(_frame(
+                    (ONEWAY, 0, "__hello__", {"role": _chaos.role})))
+            if _keepalive_interval_s > 0:
+                if self._keepalive_task is not None:
+                    self._keepalive_task.cancel()
+                self._keepalive_task = loop.create_task(
+                    self._keepalive(self._writer))
+
+    async def _keepalive(self, writer):
+        """PING the server every interval; abort the connection when no
+        frame (response OR pong) has arrived within the keepalive
+        timeout — a black-holed link becomes ConnectionLost for every
+        pending caller instead of an indefinite hang."""
+        interval = _keepalive_interval_s
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                if self._writer is not writer or writer.is_closing():
+                    return
+                if time.monotonic() - self._last_rx > _keepalive_timeout_s:
+                    try:
+                        writer.transport.abort()
+                    except Exception:
+                        pass
+                    return
+                try:
+                    if _chaos is None or _chaos.on_frame(
+                            "send", "__ping__", PING,
+                            peer=(self.host, self.port)).action == "pass":
+                        writer.write(_frame((PING, 0, "", None)))
+                        await writer.drain()
+                except Exception:
+                    return
+        except asyncio.CancelledError:
+            return
 
     async def _read_loop(self):
         try:
             while True:
                 kind, msg_id, method, payload = await _read_frame(self._reader)
+                self._last_rx = time.monotonic()
+                if kind == PONG:
+                    continue
+                if _chaos is not None:
+                    v = _chaos.on_frame("recv", method, kind,
+                                        peer=(self.host, self.port))
+                    if v.action == "drop":
+                        continue
+                    if v.action == "reset":
+                        try:
+                            self._writer.transport.abort()
+                        except Exception:
+                            pass
+                        break
+                    if v.action == "delay":
+                        fut = self._pending.pop(msg_id, None)
+                        if fut is not None:
+                            self._spawn_chaos(self._deliver_late(
+                                fut, kind, method, payload, v.delay_s))
+                        continue
                 fut = self._pending.pop(msg_id, None)
                 if fut is None or fut.done():
                     continue
@@ -267,6 +477,9 @@ class RpcClient:
                 except RuntimeError:
                     pass  # loop already closed during shutdown
             self._pending.clear()
+            if self._keepalive_task is not None:
+                self._keepalive_task.cancel()
+                self._keepalive_task = None
             if self._writer is not None:
                 try:
                     self._writer.close()
@@ -274,17 +487,85 @@ class RpcClient:
                     pass
             self._writer = None
 
+    def _spawn_chaos(self, coro):
+        t = asyncio.get_running_loop().create_task(coro)
+        self._chaos_tasks.add(t)
+        t.add_done_callback(self._chaos_tasks.discard)
+
+    @staticmethod
+    async def _deliver_late(fut, kind, method, payload, delay_s: float):
+        await asyncio.sleep(delay_s)
+        if fut.done():
+            return
+        if kind == RESPONSE_OK:
+            fut.set_result(payload)
+        else:
+            fut.set_exception(RemoteError(f"{method} failed remotely:\n{payload}"))
+
     async def connect(self) -> None:
         """Ensure the connection is open without sending anything — lets
         callers that need send-vs-connect failure attribution (actor task
         dispatch) establish the link as a separate, provably-unsent step."""
         await self._ensure()
 
-    async def call(self, method: str, timeout: Optional[float] = None, **payload) -> Any:
+    async def call(self, method: str, timeout: Optional[float] = _UNSET_TIMEOUT,
+                   **payload) -> Any:
+        """One request/response round-trip.
+
+        ``timeout`` omitted ⇒ the module default deadline
+        (Config.rpc_call_timeout_s) applies and expiry raises RpcTimeout.
+        An *explicit* ``timeout=None`` means unbounded — reserved for the
+        reviewed allowlist (raylint: unbounded-rpc-call)."""
+        if timeout is _UNSET_TIMEOUT:
+            timeout = _call_timeout_s
         fut = await self.start_call(method, **payload)
         if timeout is None:
             return await fut
-        return await asyncio.wait_for(fut, timeout)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, TimeoutError):
+            if fut.done() and not fut.cancelled():
+                # completed inside wait_for's cancellation window
+                return fut.result()
+            for mid, f in list(self._pending.items()):
+                if f is fut:
+                    self._pending.pop(mid, None)
+                    break
+            _note_timeout(self.host, self.port, method)
+            raise RpcTimeout(
+                f"rpc {method} to {self.host}:{self.port} exceeded its "
+                f"{timeout}s deadline") from None
+
+    async def _send(self, msg, method: str, kind: int) -> None:
+        """Request/oneway egress — the client-side chaos edge."""
+        if _chaos is not None:
+            v = _chaos.on_frame("send", method, kind,
+                                peer=(self.host, self.port))
+            if v.action == "drop":
+                # pretend written: the caller's deadline (or keepalive)
+                # surfaces the loss as RpcTimeout/ConnectionLost
+                return
+            if v.action == "reset":
+                try:
+                    self._writer.transport.abort()
+                except Exception:
+                    pass
+                raise ConnectionLost(
+                    f"connection to {self.host}:{self.port} reset (injected)")
+            if v.action == "delay":
+                writer, frame = self._writer, _frame(msg)
+
+                async def _later():
+                    await asyncio.sleep(v.delay_s)
+                    if self._writer is writer and not writer.is_closing():
+                        writer.write(frame)
+
+                self._spawn_chaos(_later())
+                return
+            if v.action == "duplicate":
+                self._writer.write(_frame(msg))
+        self._writer.write(_frame(msg))
+        await self._writer.drain()
 
     async def start_call(self, method: str, **payload) -> asyncio.Future:
         """Write the request frame now; return the pending future.
@@ -296,16 +577,20 @@ class RpcClient:
         msg_id = next(self._ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        self._writer.write(_frame((REQUEST, msg_id, method, payload)))
-        await self._writer.drain()
+        await self._send((REQUEST, msg_id, method, payload), method, REQUEST)
         return fut
 
     async def oneway(self, method: str, **payload) -> None:
         await self._ensure()
-        self._writer.write(_frame((ONEWAY, next(self._ids), method, payload)))
-        await self._writer.drain()
+        await self._send((ONEWAY, next(self._ids), method, payload),
+                         method, ONEWAY)
 
     async def close(self):
+        if self._keepalive_task is not None:
+            self._keepalive_task.cancel()
+            self._keepalive_task = None
+        for t in list(self._chaos_tasks):
+            t.cancel()
         if self._writer is not None:
             try:
                 self._writer.close()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import os
 import random
 import logging
 import contextvars
@@ -355,6 +356,18 @@ class Runtime:
         self.store_name = store_name
         self.node_id = node_id    # hex of the co-located nodelet's node
 
+        # Partition-tolerance deadlines (rpc_call_timeout_s, keepalive)
+        # and the optional chaos interposition layer bind per-process
+        # from this Config — the driver's _system_config and the
+        # spawned daemons' --config chain carry the same values, so one
+        # FaultPlan and one set of deadlines govern the whole cluster.
+        from ray_tpu.core import rpc as _rpc
+        from ray_tpu.devtools import chaos as _chaos
+        _rpc.configure(cfg)
+        _chaos.maybe_install(cfg, role=mode)   # "driver" | "worker"
+        _chaos.note_peer(self.gcs_addr, "gcs")
+        _chaos.note_peer(self.nodelet_addr, "nodelet")
+
         if loop is None:
             self.loop_thread: Optional[EventLoopThread] = EventLoopThread()
             self.loop = self.loop_thread.loop
@@ -550,13 +563,33 @@ class Runtime:
         return self._run(self.pool.get(tuple(addr)).call(
             method, timeout=rpc_timeout, **kw))
 
-    def gcs_call(self, method: str, rpc_timeout: Optional[float] = 60.0, **kw):
+    def gcs_call(self, method: str, rpc_timeout: Optional[float] = 60.0,
+                 clamp_attempt: bool = True, **kw):
         """kw may itself contain a `timeout` destined for the handler;
-        `rpc_timeout` is the transport deadline.
+        `rpc_timeout` is the transport deadline. ``clamp_attempt=False``
+        is for long-poll calls (wait_placement_group) whose handler
+        legitimately blocks longer than a clamped attempt would allow.
 
         Retries across GCS restarts (ref: GcsClient auto-reconnect,
-        _raylet.pyx:2111 _auto_reconnect) until gcs_reconnect_timeout_s."""
-        deadline = time.time() + self.cfg.gcs_reconnect_timeout_s
+        _raylet.pyx:2111 _auto_reconnect) until gcs_reconnect_timeout_s.
+        RpcTimeout rides the OSError family, so a gray-failed GCS (black-
+        holed link, wedged handler) is retried like a lost connection and
+        surfaces typed once the reconnect window closes. Jittered
+        exponential backoff: every driver and worker hammers a restarting
+        GCS at once, and fixed sleeps herd them into lockstep waves."""
+        # lazy: ray_tpu.util's package init needs ray_tpu fully loaded,
+        # and this module is imported during ray_tpu/__init__
+        from ray_tpu.util.backoff import Backoff
+        window = self.cfg.gcs_reconnect_timeout_s
+        # Clamp the per-attempt transport deadline so a single lost
+        # request frame (no connection error — just silence) can't burn
+        # the whole reconnect window in one attempt: the loop gets at
+        # least ~4 tries inside the window. GCS control-plane handlers
+        # are idempotent by design, so re-sending after silence is safe.
+        if clamp_attempt and rpc_timeout is not None:
+            rpc_timeout = min(rpc_timeout, max(2.0, window / 4.0))
+        bo = Backoff(base_s=0.1, cap_s=2.0,
+                     deadline_s=time.time() + window)
         client = self.pool.get(self.gcs_addr)
         while True:
             try:
@@ -573,9 +606,9 @@ class Runtime:
                     self._resubscribe_all()
                 return out
             except (ConnectionLost, OSError):
-                if self._shutdown or time.time() >= deadline:
+                if self._shutdown or bo.expired():
                     raise
-                time.sleep(0.5)
+                time.sleep(bo.next_delay())
 
     def kv_put(self, ns: str, key: bytes, value: bytes, overwrite: bool = True) -> bool:
         return self.gcs_call("kv_put", ns=ns, key=key, value=value, overwrite=overwrite)
@@ -1885,12 +1918,17 @@ class Runtime:
                     break
         deadline = time.time() + self.cfg.worker_lease_timeout_s * 4
         while time.time() < deadline:
+            # Fresh idempotency token per attempt: a duplicated frame of
+            # THIS request dedupes at the nodelet (no double grant), while
+            # a deliberate retry re-attempts with a new token.
+            idem = os.urandom(12).hex()
             try:
                 r = await self.pool.get(tuple(target)).call(
                     "request_lease", resources=spec.resources, pg=pg,
                     job_id=spec.job_id.binary(),
                     retriable=spec.max_retries != 0,
                     env_vars=_process_env(spec.runtime_env),
+                    idem=idem,
                     timeout=self.cfg.worker_lease_timeout_s + 10.0)
             except (ConnectionLost, RemoteError, OSError) as e:
                 logger.warning("lease request to %s failed: %s", target, e)
@@ -1904,6 +1942,8 @@ class Runtime:
                 continue
             st = r["status"]
             if st == "granted":
+                from ray_tpu.devtools.chaos import note_peer
+                note_peer(tuple(r["worker_addr"]), "worker")
                 return _LeasedWorker(r["lease_id"], r["worker_addr"], tuple(target),
                                      r["worker_id"])
             if st == "spillback":
@@ -1914,6 +1954,8 @@ class Runtime:
                     await asyncio.sleep(0.1)
                     continue
                 target = tuple(r["addr"])
+                from ray_tpu.devtools.chaos import note_peer
+                note_peer(target, "nodelet")
                 continue
             if st == "retry":
                 await asyncio.sleep(0.05)
@@ -1965,8 +2007,11 @@ class Runtime:
         self._record_event(spec, "RUNNING", worker=lw.worker_id.hex()[:12])
         self._task_worker[spec.task_id] = lw.worker_addr
         try:
+            # timeout=None (reviewed): a task legitimately runs for hours;
+            # worker death surfaces as ConnectionLost via the keepalive,
+            # so this await is bounded by liveness, not a deadline.
             result: TaskResult = await self.pool.get(lw.worker_addr).call(
-                "push_task", spec=spec)
+                "push_task", spec=spec, timeout=None)  # raylint: disable=unbounded-rpc-call
         except (ConnectionLost, RemoteError, OSError) as e:
             pt = self._inflight.get(spec.task_id)
             if spec.task_id in self._cancel_requested:
@@ -2141,6 +2186,9 @@ class Runtime:
             aid = ActorID.from_hex(channel.split(":", 1)[1])
             self._actor_state[aid] = message
             self._actor_addr[aid] = tuple(message["address"]) if message.get("address") else None
+            if self._actor_addr[aid] is not None:
+                from ray_tpu.devtools.chaos import note_peer
+                note_peer(self._actor_addr[aid], "worker")
             ev = self._actor_events.get(aid)
             if ev:
                 ev.set()
@@ -2232,6 +2280,8 @@ class Runtime:
                 self._actor_state[actor_id] = view
             if r.get("ok"):
                 self._actor_addr[actor_id] = tuple(view["address"])
+                from ray_tpu.devtools.chaos import note_peer
+                note_peer(self._actor_addr[actor_id], "worker")
                 return self._actor_addr[actor_id]
             if view is None or view.get("state") == "DEAD":
                 break

@@ -6,4 +6,5 @@ from ray_tpu.devtools.lint.rules import (actor_get_cycle,  # noqa: F401
                                          closure_capture, config_drift,
                                          divergent_collective, leaked_ref,
                                          locks, pep479,
+                                         unbounded_rpc,
                                          useless_suppression)

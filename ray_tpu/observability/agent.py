@@ -26,6 +26,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu.core import rpc as _rpc
 from ray_tpu.observability import health as _health
 from ray_tpu.observability import memory as _memory
 from ray_tpu.util import metrics as _metrics
@@ -42,6 +43,7 @@ class TelemetryAgent:
         self._events: List[dict] = []       # task events + spans, in order
         self._edges: List[dict] = []
         self._carry: List[dict] = []        # metric deltas from failed ships
+        self._susp_carry: List[dict] = []   # rpc-timeout suspicions, same
         self.events_dropped = 0
         self.reports_dropped = 0
         self.reports_sent = 0
@@ -179,8 +181,14 @@ class TelemetryAgent:
                 _memory.publish_gauges()
             except Exception:
                 mem = None
+            # RPC-timeout suspicions (core/rpc.py deadline misses): the
+            # caller can't tell a dead peer from a black-holed link, so
+            # it reports *suspicion* and the GCS health plane aggregates
+            # (gray-failure detection needs cross-observer evidence).
+            suspicions = self._susp_carry + _rpc.drain_timeout_suspicions()
+            self._susp_carry = []
             if not (events or edges or metric_deltas or self_deltas
-                    or beacons or mem):
+                    or beacons or mem or suspicions):
                 return True
             report = {"events": events, "edges": edges,
                       "metrics": metric_deltas + self_deltas,
@@ -189,6 +197,8 @@ class TelemetryAgent:
                       "node": getattr(self._rt, "node_id", None)}
             if mem:
                 report["memory"] = mem
+            if suspicions:
+                report["rpc_suspicions"] = suspicions
             try:
                 reply = self._rt.gcs_call("telemetry_report", report=report,
                                           rpc_timeout=10.0)
@@ -204,6 +214,7 @@ class TelemetryAgent:
                     self._events = merged
                     self._edges = (edges + self._edges)[-_EDGE_BUFFER_CAP:]
                     self._carry = metric_deltas + self._carry
+                    self._susp_carry = (suspicions + self._susp_carry)[-256:]
                 return False
             with self._lock:
                 self.reports_sent += 1

@@ -204,6 +204,9 @@ class HealthAggregator:
         # task name -> per-bucket completion counts (STRAGGLER_BOUNDARIES)
         self._durations: Dict[str, List[int]] = {}
         self._flagged_stragglers: set = set()
+        # peer addr -> rpc-deadline suspicion fold (gray-failure
+        # evidence: callers whose calls to that peer timed out)
+        self._rpc_susp: Dict[str, dict] = {}
 
     # ------------------------------------------------------------- beacons
 
@@ -366,6 +369,49 @@ class HealthAggregator:
         self._fresh.append(ev)
         return ev
 
+    # ------------------------------------------------- rpc-timeout suspicion
+
+    # A call that exceeds its deadline can't distinguish a dead peer
+    # from a black-holed link or a slow server — gray failure. The
+    # caller reports *suspicion* (core/rpc.py counters riding the
+    # telemetry report); this fold turns repeated suspicion — ideally
+    # from multiple observers — into a peer_suspect health event, once
+    # per episode. An episode resets after a quiet window.
+    _SUSP_THRESHOLD = 3
+    _SUSP_QUIET_S = 60.0
+
+    def observe_rpc_suspicions(self, reporter: str, node: Optional[str],
+                               suspicions: List[dict],
+                               now: Optional[float] = None) -> List[StallEvent]:
+        now = time.time() if now is None else now
+        fresh: List[StallEvent] = []
+        for s in suspicions or []:
+            peer = str(s.get("peer", "?"))
+            n = int(s.get("count", 1))
+            method = str(s.get("method", "?"))
+            st = self._rpc_susp.get(peer)
+            if st is None or now - st["last_ts"] > self._SUSP_QUIET_S:
+                st = {"count": 0, "reporters": set(), "methods": {},
+                      "last_ts": now, "flagged": False}
+                self._rpc_susp[peer] = st
+            st["count"] += n
+            st["reporters"].add(reporter)
+            st["methods"][method] = st["methods"].get(method, 0) + n
+            st["last_ts"] = now
+            if not st["flagged"] and st["count"] >= self._SUSP_THRESHOLD:
+                st["flagged"] = True
+                ev = StallEvent(
+                    kind="peer_suspect", component=f"rpc:{peer}",
+                    worker=reporter, node=node, age_s=0.0, deadline_s=0.0,
+                    context={"count": st["count"],
+                             "reporters": sorted(st["reporters"]),
+                             "methods": dict(st["methods"])},
+                    ts=now)
+                self.events.append(ev)
+                self._fresh.append(ev)
+                fresh.append(ev)
+        return fresh
+
     # ------------------------------------------------------------ reporting
 
     def report(self, now: Optional[float] = None) -> dict:
@@ -380,6 +426,16 @@ class HealthAggregator:
                 "deadline_s": st.deadline_s, "stalled": st.stalled,
                 "context": dict(st.context),
             })
+        suspects = []
+        for peer, st in sorted(self._rpc_susp.items()):
+            if now - st["last_ts"] > self._SUSP_QUIET_S:
+                continue
+            suspects.append({"peer": peer, "count": st["count"],
+                             "reporters": sorted(st["reporters"]),
+                             "methods": dict(st["methods"]),
+                             "quiet_s": round(now - st["last_ts"], 3),
+                             "flagged": st["flagged"]})
         return {"beacons": beacons,
                 "events": [dict(e) for e in self.events],
+                "rpc_suspects": suspects,
                 "running_tasks": len(self._running)}

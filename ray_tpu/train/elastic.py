@@ -246,6 +246,11 @@ class ElasticCoordinator:
         self.worker_res = trainer.scaling.worker_resources()
         self.run_tag = ""
         self.summary: Dict[str, Any] = {}
+        # Monotonic per-reporter sequence on gang-demand reports: the
+        # GCS drops any report whose seq is <= the last one applied, so
+        # a delayed/duplicated stale report (network reordering, chaos
+        # plane) cannot resurrect demand a newer count=0 cleared.
+        self._gang_seq = 0
 
     # -- GCS plumbing (all best-effort: the gang must survive a GCS blip) --
 
@@ -272,9 +277,11 @@ class ElasticCoordinator:
         report_load shape); count=0 clears the row once whole."""
         shortfall = max(0, min(self.target, self.max_workers)
                         - group.num_workers)
+        self._gang_seq += 1
         self._gcs_call("report_gang_demand", name=f"train:{self.run_tag}",
                        reporter=self.run_tag,
-                       resources=dict(self.worker_res), count=shortfall)
+                       resources=dict(self.worker_res), count=shortfall,
+                       seq=self._gang_seq)
 
     def _capacity_available(self) -> bool:
         """Cheap pre-gate for a grow attempt: some node's available
@@ -403,10 +410,12 @@ class ElasticCoordinator:
                         "found no capacity")
                     return result
         finally:
+            self._gang_seq += 1
             self._gcs_call("report_gang_demand",
                            name=f"train:{self.run_tag}",
                            reporter=self.run_tag,
-                           resources=dict(self.worker_res), count=0)
+                           resources=dict(self.worker_res), count=0,
+                           seq=self._gang_seq)
             group.shutdown()
 
     # -- one generation -------------------------------------------------------

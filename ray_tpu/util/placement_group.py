@@ -23,7 +23,8 @@ class PlacementGroup:
     def ready(self, timeout: float = 30.0) -> bool:
         r = rt.get_runtime().gcs_call("wait_placement_group", pg_id=self.id,
                                       wait_timeout=timeout,
-                                      rpc_timeout=timeout + 10.0)
+                                      rpc_timeout=timeout + 10.0,
+                                      clamp_attempt=False)  # long-poll
         return bool(r.get("ok"))
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:

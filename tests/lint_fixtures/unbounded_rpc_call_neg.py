@@ -1,0 +1,34 @@
+"""Negative fixture: bounded, defaulted, or justified RPC calls."""
+
+
+async def default_deadline(client, spec):
+    # no timeout kwarg: inherits rpc_call_timeout_s from the sentinel
+    return await client.call("request_lease", spec=spec)
+
+
+async def explicit_bound(client):
+    return await client.call("get_nodes", timeout=5.0)
+
+
+async def bound_from_config(client, cfg):
+    return await client.call("create_actor",
+                             timeout=cfg.worker_start_timeout_s)
+
+
+async def justified_unbounded(client, spec):
+    # timeout=None (reviewed): bounded by connection liveness via the
+    # keepalive, not by a deadline — tasks legitimately run for hours
+    return await client.call(
+        "push_task", spec=spec, timeout=None)  # raylint: disable=unbounded-rpc-call
+
+
+def not_an_rpc(waiter):
+    # a non-RPC .call with a timeout kwarg of None but no RPC receiver
+    # still matches the shape — suppression is the documented escape;
+    # plain calls without timeout=None never flag
+    return waiter.call("anything")
+
+
+async def wait_with_none_elsewhere(client, fut):
+    # timeout=None on something that is not .call/.start_call
+    return await client.wait(fut, timeout=None)

@@ -1,0 +1,17 @@
+"""Positive fixture: explicit timeout=None on RPC calls, unjustified."""
+
+
+async def bare_call(pool, addr, spec):
+    # unbounded await on a remote peer: hangs forever if the link
+    # black-holes after the request frame is written
+    return await pool.get(addr).call("push_task", spec=spec, timeout=None)
+
+
+async def through_client(client):
+    r = await client.call("get_nodes", timeout=None)
+    return r
+
+
+async def start_call_form(client, spec):
+    fut = await client.start_call("push_actor_task", spec=spec, timeout=None)
+    return await fut
