@@ -876,6 +876,354 @@ def run_serve_disagg_bench(concurrency: int = 48, n_long: int = 48,
     return result
 
 
+def run_serve_multiplex_bench(n_models: int = 8, n_tenants: int = 4,
+                              num_replicas: int = 3,
+                              concurrency: int = 12,
+                              requests_per_phase: int = 160,
+                              flood_concurrency: int = 8,
+                              max_models_per_replica: int = 4,
+                              repeats: int = 1,
+                              out_path: str = "BENCH_serve_multiplex.json",
+                              init_cluster: bool = True,
+                              autoscale_phase: bool = True):
+    """Fleet-scale model multiplexing under a SKEWED multi-model,
+    multi-tenant workload (zipf-ish popularity over n_models, tenants
+    round-robin). Three measurements:
+
+    1. warm-model hit rate, model-affinity vs random placement at a
+       matched replica budget. Each replica's LRU holds
+       max_models_per_replica < n_models, so random placement THRASHES
+       (every replica keeps cold-loading the whole catalog) while the
+       (model, prefix) rendezvous key partitions the catalog so each
+       replica's working set fits. hit rate = 1 - cold_loads/requests,
+       from the replicas' own load counters. A single-model cell at the
+       same budget gives the no-multiplexing tok/s baseline.
+    2. weighted-fair admission: per-tenant client TTFT p99 uncontended,
+       then with one tenant flooding. Acceptance: compliant tenants'
+       p99 stays within 1.5x of uncontended and the flooder absorbs
+       every shed (typed 429s, per-tenant counters).
+    3. per-model autoscaling: sustained demand on one model; the
+       controller's decision table must grow its serving set toward
+       load/target (sampled timeline recorded).
+
+    Writes BENCH_serve_multiplex.json; headline is the affinity cell's
+    warm-model hit rate."""
+    import queue as _q
+    import random as _rnd
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm_deployment import build_llm_app
+
+    tenants = [f"tenant-{i}" for i in range(n_tenants)]
+    model_w = [1.0 / (i + 1) for i in range(n_models)]   # zipf-ish skew
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return round(xs[min(int(p * len(xs)), len(xs) - 1)], 4) \
+            if xs else None
+
+    def _bodies(seed):
+        rng = _rnd.Random(seed)
+        out = []
+        for i in range(requests_per_phase):
+            m = rng.choices(range(n_models), weights=model_w)[0]
+            out.append({"prompt": [m * 1000 + j for j in range(16)]
+                        + [777_000 + i],
+                        "max_new_tokens": 16,
+                        "model": f"model-{m}",
+                        "tenant": tenants[i % n_tenants]})
+        return out
+
+    def _pool_stats(name):
+        controller = ray_tpu.get_actor("_serve_controller",
+                                       namespace="serve")
+        reps = ray_tpu.get(controller.get_replicas.remote(name))
+        return ray_tpu.get([r.handle_request.remote("stats", (), {}, None)
+                            for r in reps])
+
+    def _drive(handle, bodies, n_workers):
+        """Run bodies at fixed concurrency; returns per-tenant TTFTs,
+        token count and wall."""
+        work: "_q.Queue" = _q.Queue()
+        for b in bodies:
+            work.put(b)
+        lock = threading.Lock()
+        ttfts: dict = {}
+        tokens = [0]
+        sheds = [0]
+
+        def worker():
+            while True:
+                try:
+                    body = work.get_nowait()
+                except _q.Empty:
+                    return
+                t0 = time.time()
+                first, got, shed = None, 0, False
+                gen = handle.options(stream=True).method(
+                    "stream_request").remote(body)
+                for ref in gen:
+                    item = ray_tpu.get(ref)
+                    if item.get("status") == 429:
+                        shed = True
+                    if item.get("tokens") and first is None:
+                        first = time.time() - t0
+                    got += len(item.get("tokens", []))
+                with lock:
+                    if shed:
+                        sheds[0] += 1
+                    elif first is not None:
+                        ttfts.setdefault(body.get("tenant", "default"),
+                                         []).append(first)
+                    tokens[0] += got
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_workers)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return ttfts, tokens[0], time.time() - t0, sheds[0]
+
+    sim_kw = dict(max_slots=8, max_queue_depth=None,
+                  decode_s_per_token=0.002, model_load_s=0.08,
+                  multiplexed=True, max_models=max_models_per_replica)
+
+    def run_hit_cell(policy):
+        app = build_llm_app(
+            name="mx", use_sim=True, num_replicas=num_replicas,
+            router_policy=policy,
+            router_kwargs={"max_inflight": 100_000,
+                           "stats_interval_s": 0.25},
+            **sim_kw)
+        handle = serve.run(app)
+        ttfts, toks, wall, _ = [], 0, 0.0, 0
+        agg = {}
+        for rep in range(max(repeats, 1)):
+            tt, tk, w, _ = _drive(handle, _bodies(rep), concurrency)
+            for t, xs in tt.items():
+                agg.setdefault(t, []).extend(xs)
+            toks += tk
+            wall += w
+        stats = _pool_stats("mx")
+        reqs = sum(s["requests"] for s in stats)
+        loads = sum(s["model_loads"] for s in stats)
+        evics = sum(s["model_evictions"] for s in stats)
+        rstats = ray_tpu.get(handle.method("stats").remote())
+        serve.shutdown()
+        return {
+            "policy": policy,
+            "n_requests": reqs,
+            "tok_per_s": round(toks / wall, 1),
+            "cold_loads": loads,
+            "evictions": evics,
+            "warm_hit_rate": round(1.0 - loads / max(reqs, 1), 4),
+            "ttft_p99_s_per_tenant": {t: pct(xs, 0.99)
+                                      for t, xs in sorted(agg.items())},
+            "warm_model_picks": rstats.get("warm_model_picks", 0),
+            "cold_model_picks": rstats.get("cold_model_picks", 0),
+        }
+
+    def run_single_model_cell():
+        """No multiplexing, one model: the tok/s baseline the multi-model
+        cells are compared against at the same replica budget."""
+        kw = dict(sim_kw)
+        kw["multiplexed"] = False
+        app = build_llm_app(
+            name="mono", use_sim=True, num_replicas=num_replicas,
+            router_policy="affinity",
+            router_kwargs={"max_inflight": 100_000,
+                           "stats_interval_s": 0.25}, **kw)
+        handle = serve.run(app)
+        bodies = [{"prompt": b["prompt"],
+                   "max_new_tokens": b["max_new_tokens"]}
+                  for b in _bodies(0)]
+        _, toks, wall, _ = _drive(handle, bodies, concurrency)
+        serve.shutdown()
+        return {"tok_per_s": round(toks / wall, 1),
+                "n_requests": len(bodies)}
+
+    def run_fairness():
+        """Uncontended per-tenant p99, then one tenant floods."""
+        # admission bound sized so the flood ALONE can saturate it —
+        # compliant tenants stay inside their guaranteed shares while
+        # the flooder's borrow attempts past the cap eat the 429s
+        app = build_llm_app(
+            name="fair", use_sim=True, num_replicas=num_replicas,
+            router_policy="p2c",
+            router_kwargs={"max_inflight": max(4, flood_concurrency),
+                           "stats_interval_s": 0.25},
+            tenant_weights={t: 1.0 for t in tenants},
+            max_slots=4 * concurrency, max_queue_depth=None,
+            decode_s_per_token=0.004, multiplexed=False)
+        handle = serve.run(app)
+        compliant = tenants[1:]
+        flood = tenants[0]
+
+        def tenant_bodies(ts, n):
+            return [{"prompt": [4] * 12, "max_new_tokens": 16,
+                     "tenant": ts[i % len(ts)]} for i in range(n)]
+
+        # phase A: everyone compliant, light concurrency
+        tt_a, _, _, sheds_a = _drive(
+            handle, tenant_bodies(tenants, requests_per_phase),
+            len(tenants))
+        p99_a = {t: pct(xs, 0.99) for t, xs in sorted(tt_a.items())}
+        # phase B: flood tenant hammers with flood_concurrency loopers
+        # while the compliant tenants repeat phase A's pattern
+        stop = threading.Event()
+
+        def flooder():
+            while not stop.is_set():
+                gen = handle.options(stream=True).method(
+                    "stream_request").remote(
+                        {"prompt": [6] * 12, "max_new_tokens": 48,
+                         "tenant": flood})
+                for ref in gen:
+                    ray_tpu.get(ref)
+
+        fthreads = [threading.Thread(target=flooder)
+                    for _ in range(flood_concurrency)]
+        for t in fthreads:
+            t.start()
+        try:
+            time.sleep(0.5)   # let the flood reach the admission bound
+            tt_b, _, _, _ = _drive(
+                handle, tenant_bodies(compliant, requests_per_phase),
+                len(compliant))
+        finally:
+            stop.set()
+            for t in fthreads:
+                t.join(timeout=60)
+        p99_b = {t: pct(xs, 0.99) for t, xs in sorted(tt_b.items())}
+        rstats = ray_tpu.get(handle.method("stats").remote())
+        ts_stats = rstats["tenant_stats"]
+        serve.shutdown()
+        ratios = [p99_b[t] / max(p99_a[t], 1e-9)
+                  for t in compliant if p99_a.get(t) and p99_b.get(t)]
+        return {
+            "uncontended_p99_s": p99_a,
+            "contended_p99_s": p99_b,
+            "uncontended_sheds": sheds_a,
+            "compliant_p99_ratio_max": round(max(ratios), 3)
+            if ratios else None,
+            "flood_tenant": flood,
+            "sheds_per_tenant": {t: int(v.get("shed", 0))
+                                 for t, v in sorted(ts_stats.items())},
+            "admits_per_tenant": {t: int(v.get("requests", 0))
+                                  for t, v in sorted(ts_stats.items())},
+        }
+
+    def run_autoscale():
+        """Pump one model, sample the controller's per-model table."""
+        app = build_llm_app(
+            name="scale", use_sim=True, num_replicas=num_replicas,
+            router_policy="affinity",
+            model_autoscaling_config={
+                "target_load_per_model_replica": 1.0,
+                "look_back_period_s": 1.0, "upscale_delay_s": 0.0,
+                "downscale_delay_s": 120.0},
+            router_kwargs={"stats_interval_s": 0.25},
+            multiplexed=True, max_slots=2, decode_s_per_token=0.02,
+            model_load_s=0.02, max_queue_depth=None)
+        handle = serve.run(app)
+        controller = ray_tpu.get_actor("_serve_controller",
+                                       namespace="serve")
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                gen = handle.options(stream=True).method(
+                    "stream_request").remote(
+                        {"prompt": [5] * 8, "max_new_tokens": 8,
+                         "model": "hot"})
+                for ref in gen:
+                    ray_tpu.get(ref)
+
+        threads = [threading.Thread(target=pump) for _ in range(6)]
+        for t in threads:
+            t.start()
+        samples = []
+        try:
+            deadline = time.time() + 40
+            t0 = time.time()
+            while time.time() < deadline:
+                st = ray_tpu.get(controller.model_status.remote("scale"))
+                hot = (st.get("models") or {}).get("hot")
+                if hot:
+                    samples.append({"t_s": round(time.time() - t0, 2),
+                                    "serving": hot["serving"],
+                                    "want": hot["want"],
+                                    "load": round(hot["load"], 2)})
+                    if hot["serving"] >= 2 and hot["want"] >= 2:
+                        break
+                time.sleep(0.25)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        serve.shutdown()
+        final = samples[-1] if samples else {}
+        return {"samples": samples[-12:],
+                "final_serving": final.get("serving", 0),
+                "final_want": final.get("want", 0),
+                "converged": bool(final) and final["serving"] >= 2}
+
+    if init_cluster:
+        ray_tpu.init(num_cpus=max(16, num_replicas + 4),
+                     ignore_reinit_error=True)
+    affinity = run_hit_cell("affinity")
+    randomly = run_hit_cell("random")
+    single = run_single_model_cell()
+    fairness = run_fairness()
+    scale = run_autoscale() if autoscale_phase else None
+    if init_cluster:
+        ray_tpu.shutdown()
+
+    ratio = fairness["compliant_p99_ratio_max"]
+    sheds = fairness["sheds_per_tenant"]
+    flood = fairness["flood_tenant"]
+    compliant_sheds = sum(v for t, v in sheds.items() if t != flood)
+    acceptance = {
+        "affinity_beats_random_warm_hit_rate":
+            affinity["warm_hit_rate"] > randomly["warm_hit_rate"],
+        "compliant_p99_within_1p5x_of_uncontended":
+            ratio is not None and ratio <= 1.5,
+        "flooder_shed_first":
+            sheds.get(flood, 0) > 0 and compliant_sheds == 0,
+    }
+    if scale is not None:
+        acceptance["per_model_autoscale_converges"] = scale["converged"]
+    result = {
+        "metric": "serve_multiplex_warm_hit_rate_affinity",
+        "value": affinity["warm_hit_rate"],
+        "unit": "fraction",
+        "vs_baseline": randomly["warm_hit_rate"],
+        "extra": {
+            "affinity": affinity,
+            "random": randomly,
+            "single_model_baseline": single,
+            "fairness": fairness,
+            "autoscale": scale,
+            "acceptance": acceptance,
+            "note": f"skewed {n_models}-model catalog (zipf-ish), "
+                    f"{n_tenants} tenants, {num_replicas} replicas x "
+                    f"{max_models_per_replica}-model LRU; hit rate = "
+                    "1 - cold_loads/requests from replica counters; "
+                    "fairness = per-tenant client TTFT p99, one tenant "
+                    "flooding vs uncontended; autoscale = controller "
+                    "per-model decision table timeline",
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return result
+
+
 def run_dag_bench(chain_len: int = 4, iters: int = 150,
                   data_blocks: int = 50, data_rows_per_block: int = 512,
                   out_path: str = "BENCH_dag.json"):
@@ -1518,7 +1866,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--bench", default="train",
                     choices=("train", "collective", "data", "telemetry",
-                             "serve_router", "serve_disagg", "dag",
+                             "serve_router", "serve_disagg",
+                             "serve_multiplex", "dag",
                              "memory", "train_elastic"),
                     help="train = headline tokens/s/chip (default); "
                          "collective = host-collective backend sweep "
@@ -1532,6 +1881,10 @@ if __name__ == "__main__":
                          "serve_disagg = disaggregated prefill/decode vs "
                          "monolithic under mixed traffic (writes "
                          "BENCH_serve_disagg.json); "
+                         "serve_multiplex = model multiplexing + "
+                         "weighted-fair tenants: warm-hit rate, fairness "
+                         "under flood, per-model autoscale (writes "
+                         "BENCH_serve_multiplex.json); "
                          "dag = per-hop .remote() vs lazy vs compiled "
                          "graph dispatch (writes BENCH_dag.json); "
                          "memory = attribution overhead on the put/get "
@@ -1550,6 +1903,8 @@ if __name__ == "__main__":
         run_serve_router_bench()
     elif ns.bench == "serve_disagg":
         run_serve_disagg_bench()
+    elif ns.bench == "serve_multiplex":
+        run_serve_multiplex_bench()
     elif ns.bench == "dag":
         run_dag_bench()
     elif ns.bench == "memory":

@@ -173,6 +173,25 @@ class Config:
     # immediately, then given this long to finish in-flight streams
     # before the controller kills it.
     serve_drain_timeout_s: float = 10.0
+    # --- model multiplexing (ray_tpu/serve/multiplex.py) --------------------
+    # Per-replica LRU bound on concurrently-loaded models; loading one
+    # past the bound evicts the least-recently-used model through the
+    # cache's unloader hook (engine teardown + page-pool release).
+    serve_max_models_per_replica: int = 4
+    # Weighted-fair tenant admission: JSON map of tenant -> weight, e.g.
+    # '{"free": 1, "pro": 4}'. "" means every tenant weighs 1. A tenant
+    # absent from the map gets weight 1. Shares of the router's
+    # max_inflight are split by weight over the tenants active at
+    # admission time; a tenant is always admitted up to its guaranteed
+    # share and may borrow idle capacity up to the global cap. (A JSON
+    # string, not a dict field: RAY_TPU_* env overrides parse by field
+    # type and only bool/int/float/str survive that path.)
+    serve_tenant_weights: str = ""
+    # Per-model autoscaling target: desired mean per-model queue depth
+    # per replica serving that model. The controller sizes each model's
+    # replica set to ceil(model_load / this) within the deployment's
+    # model_autoscaling_config bounds.
+    serve_model_target_load: float = 2.0
     # --- disaggregated serving (ray_tpu/serve/disagg.py) --------------------
     # Tokens per KV page for the handoff/prefix-directory hashing (the
     # sim granularity; the real engine hashes at its own page_size).

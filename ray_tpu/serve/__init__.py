@@ -35,13 +35,15 @@ from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import Request, Response
 from ray_tpu.serve.llm_deployment import SimLLMServer, build_llm_app
 from ray_tpu.serve.llm_router import LLMRouter
-from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.multiplex import (ModelRegistry, get_multiplexed_model_id,
+                                     get_request_tenant, multiplexed)
 
 __all__ = [
     "deployment", "run", "shutdown", "start", "status",
     "get_deployment_handle", "batch", "Deployment", "Application",
     "DeploymentHandle", "Request", "Response", "multiplexed",
-    "get_multiplexed_model_id", "build_app", "InputNode", "DAGDriverImpl",
+    "get_multiplexed_model_id", "get_request_tenant", "ModelRegistry",
+    "build_app", "InputNode", "DAGDriverImpl",
     "start_grpc", "shutdown_grpc", "GrpcServeClient",
     "LLMRouter", "SimLLMServer", "build_llm_app",
     "DisaggRouter", "PrefixDirectory", "HandoffExporter", "HandoffAdopter",

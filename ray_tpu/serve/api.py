@@ -25,6 +25,7 @@ class Deployment:
     max_concurrent_queries: int = 100
     user_config: Any = None
     autoscaling_config: Optional[dict] = None
+    model_autoscaling_config: Optional[dict] = None
     ray_actor_options: Optional[dict] = None
     init_args: tuple = ()
     init_kwargs: dict = field(default_factory=dict)
@@ -32,8 +33,8 @@ class Deployment:
     def bind(self, *args, **kwargs) -> "Application":
         d = Deployment(self.func_or_class, self.name, self.num_replicas,
                        self.max_concurrent_queries, self.user_config,
-                       self.autoscaling_config, self.ray_actor_options,
-                       args, kwargs)
+                       self.autoscaling_config, self.model_autoscaling_config,
+                       self.ray_actor_options, args, kwargs)
         # Composition (ref: deployment_graph_build.py): nested bound
         # deployments in the init args join this application's deployment
         # list; serve.run turns them into handles at deploy time.
@@ -61,6 +62,8 @@ class Deployment:
                               self.max_concurrent_queries),
                        kw.pop("user_config", self.user_config),
                        kw.pop("autoscaling_config", self.autoscaling_config),
+                       kw.pop("model_autoscaling_config",
+                              self.model_autoscaling_config),
                        kw.pop("ray_actor_options", self.ray_actor_options))
         if kw:
             raise ValueError(f"unknown deployment options {sorted(kw)}")
@@ -115,11 +118,13 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 100,
                user_config: Any = None,
                autoscaling_config: Optional[dict] = None,
+               model_autoscaling_config: Optional[dict] = None,
                ray_actor_options: Optional[dict] = None):
     def deco(obj):
         return Deployment(obj, name or getattr(obj, "__name__", "deployment"),
                           num_replicas, max_concurrent_queries, user_config,
-                          autoscaling_config, ray_actor_options)
+                          autoscaling_config, model_autoscaling_config,
+                          ray_actor_options)
 
     if _func_or_class is not None:
         return deco(_func_or_class)
@@ -180,6 +185,7 @@ def run(app: Application, *, route_prefix: Optional[str] = None,
             "max_concurrent_queries": d.max_concurrent_queries,
             "user_config": d.user_config,
             "autoscaling_config": d.autoscaling_config,
+            "model_autoscaling_config": d.model_autoscaling_config,
             "ray_actor_options": d.ray_actor_options,
         }
         ray_tpu.get(controller.deploy.remote(

@@ -52,10 +52,14 @@ class Replica:
                              context: dict | None = None):
         self.inflight += 1
         try:
-            if context and "multiplexed_model_id" in context:
-                from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+            if context:
+                from ray_tpu.serve.multiplex import (_set_multiplexed_model_id,
+                                                     _set_request_tenant)
 
-                _set_multiplexed_model_id(context["multiplexed_model_id"])
+                if "multiplexed_model_id" in context:
+                    _set_multiplexed_model_id(context["multiplexed_model_id"])
+                if "tenant" in context:
+                    _set_request_tenant(context["tenant"])
             import asyncio
             import inspect
 
@@ -83,10 +87,14 @@ class Replica:
         and yields each item as a stream element."""
         self.inflight += 1
         try:
-            if context and "multiplexed_model_id" in context:
-                from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+            if context:
+                from ray_tpu.serve.multiplex import (_set_multiplexed_model_id,
+                                                     _set_request_tenant)
 
-                _set_multiplexed_model_id(context["multiplexed_model_id"])
+                if "multiplexed_model_id" in context:
+                    _set_multiplexed_model_id(context["multiplexed_model_id"])
+                if "tenant" in context:
+                    _set_request_tenant(context["tenant"])
             import asyncio
             import inspect
 
@@ -186,6 +194,16 @@ class ServeController:
         # routers push their local queue depth here so autoscaling sees
         # demand that was SHED before reaching any replica's queue.
         self._ext_load: Dict[str, Dict[str, tuple]] = {}
+        # per-model external load: name -> {reporter: (ts, {model: load})}
+        self._ext_mload: Dict[str, Dict[str, tuple]] = {}
+        # per-model autoscaling state (multiplexed deployments):
+        # look-back samples keyed (name, model), pending-decision delays,
+        # in-flight scale ops (one per model at a time), and the last
+        # decision table exposed via model_status()
+        self._mhist: Dict[tuple, List[tuple]] = {}
+        self._pending_mscale: Dict[tuple, tuple] = {}
+        self._model_ops: set = set()
+        self._model_table: Dict[str, dict] = {}
         self._restore()
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
         self._thread.start()
@@ -321,14 +339,21 @@ class ServeController:
                          "config": d["config"], "version": d["version"]}
         return out
 
-    def report_load(self, name: str, reporter: str, load: float) -> bool:
+    def report_load(self, name: str, reporter: str, load: float,
+                    model_load: Optional[Dict[str, float]] = None) -> bool:
         """Routers push their OWN queue depth (requests admitted by the
         router but not yet placed on a replica). Folded into the
         autoscale total each control tick; stale reporters (a dead
-        router) age out after 10 s so they cannot pin the fleet up."""
+        router) age out after 10 s so they cannot pin the fleet up.
+        model_load, when given, is the router's per-model split of that
+        depth — the per-model autoscaler's demand signal."""
         with self._lock:
             self._ext_load.setdefault(name, {})[reporter] = (
                 time.time(), float(load))
+            if model_load is not None:
+                self._ext_mload.setdefault(name, {})[reporter] = (
+                    time.time(), {str(m): float(v)
+                                  for m, v in model_load.items()})
         return True
 
     def _ext_load_total(self, name: str) -> float:
@@ -339,6 +364,24 @@ class ServeController:
             for k in stale:
                 del per[k]
             return sum(load for _, load in per.values())
+
+    def _ext_model_load(self, name: str) -> Dict[str, float]:
+        """Aged, summed per-model router demand."""
+        now = time.time()
+        out: Dict[str, float] = {}
+        with self._lock:
+            per = self._ext_mload.get(name, {})
+            stale = [k for k, (ts, _) in per.items() if now - ts > 10.0]
+            for k in stale:
+                del per[k]
+            for _, (_, d) in per.items():
+                for m, v in d.items():
+                    out[m] = out.get(m, 0.0) + v
+        return out
+
+    def model_status(self, name: str) -> dict:
+        """Last per-model autoscale decision table (tests/bench)."""
+        return dict(self._model_table.get(name, {}))
 
     def ping(self) -> str:
         return "pong"
@@ -529,6 +572,142 @@ class ServeController:
             return want
         return None
 
+    # ---- per-model autoscaling (multiplexed deployments) -------------------
+
+    def _models_tick(self, name: str, d: dict):
+        """One control-loop tick of the per-model scaler: poll each
+        replica's model_stats, fold in the routers' per-model demand,
+        and size every model's serving set toward
+        load / target_load_per_model_replica (look-back averaged, with
+        up/down delays). Scale ops run on a background thread — loading
+        a model can take seconds and must not stall the control loop."""
+        mcfg = d["config"].get("model_autoscaling_config")
+        if not mcfg:
+            return
+        replicas = list(d["replicas"])
+        if not replicas:
+            return
+        try:
+            res = ray_tpu.get(
+                [r.handle_request.remote("model_stats", (), {}, None)
+                 for r in replicas], timeout=5)
+        except Exception:
+            return
+        stats = [(r, st if isinstance(st, dict) else {})
+                 for r, st in zip(replicas, res)]
+        serving: Dict[str, list] = {}     # model -> replica indices
+        local_load: Dict[str, float] = {}
+        for i, (_, st) in enumerate(stats):
+            for m in st.get("models", []):
+                serving.setdefault(m, []).append(i)
+            for m, q in (st.get("queues") or {}).items():
+                local_load[m] = local_load.get(m, 0.0) + float(q)
+        ext = self._ext_model_load(name)
+        models = set(serving) | set(ext) | set(local_load)
+        if not models:
+            self._model_table[name] = {"ts": time.time(), "models": {}}
+            return
+        from ray_tpu.core.config import GLOBAL_CONFIG
+        per = float(mcfg.get("target_load_per_model_replica",
+                             GLOBAL_CONFIG.serve_model_target_load))
+        look_back = float(mcfg.get("look_back_period_s", 10.0))
+        mn = int(mcfg.get("min_replicas_per_model", 1))
+        mx = int(mcfg.get("max_replicas_per_model", len(replicas)))
+        now = time.time()
+        table: Dict[str, dict] = {}
+        for m in sorted(models):
+            load = local_load.get(m, 0.0) + ext.get(m, 0.0)
+            hist = self._mhist.setdefault((name, m), [])
+            hist.append((now, load))
+            while hist and hist[0][0] < now - look_back:
+                hist.pop(0)
+            avg = sum(v for _, v in hist) / max(len(hist), 1)
+            cur = len(serving.get(m, []))
+            want = max(mn, min(mx, int((avg + per - 1) // per) or mn))
+            table[m] = {"serving": cur, "want": want, "load": load,
+                        "avg_load": avg}
+            if want == cur:
+                self._pending_mscale.pop((name, m), None)
+                continue
+            if (name, m) in self._model_ops:
+                continue   # previous op for this model still running
+            direction = "up" if want > cur else "down"
+            delay = float(mcfg.get("upscale_delay_s", 0.0)
+                          if direction == "up"
+                          else mcfg.get("downscale_delay_s", 5.0))
+            pend = self._pending_mscale.get((name, m))
+            if pend is None or pend[0] != direction:
+                self._pending_mscale[(name, m)] = (direction, now)
+                pend = self._pending_mscale[(name, m)]
+            if now - pend[1] >= delay:
+                self._pending_mscale.pop((name, m), None)
+                self._model_ops.add((name, m))
+                threading.Thread(
+                    target=self._apply_model_scale,
+                    args=(name, m, want, stats, serving.get(m, [])),
+                    daemon=True).start()
+        self._model_table[name] = {"ts": now, "models": table}
+
+    def _apply_model_scale(self, name: str, model: str, want: int,
+                           stats: List[tuple], serving_idx: List[int]):
+        """Background scale op for one model. Up: warm-load on the
+        least-loaded replicas not yet serving it. Down: unpublish (the
+        replica stops advertising, routers drain away), poll the
+        per-model queue to 0 under serve_drain_timeout_s, then unload —
+        PR 10's drain protocol applied at model granularity."""
+        from ray_tpu.core.config import GLOBAL_CONFIG
+        try:
+            cur = len(serving_idx)
+            if want > cur:
+                # candidates: replicas not serving the model, coldest
+                # (fewest queued requests across their models) first
+                cand = [(sum((st.get("queues") or {}).values()),
+                         len(st.get("resident", [])), i, r)
+                        for i, (r, st) in enumerate(stats)
+                        if i not in serving_idx and not st.get("draining")]
+                cand.sort(key=lambda t: (t[0], t[1]))
+                for _, _, _, r in cand[:want - cur]:
+                    try:
+                        ray_tpu.get(r.handle_request.remote(
+                            "load_model", (model,), {}, None), timeout=120)
+                    except Exception:
+                        pass   # replica died/failed: next tick retries
+                return
+            # scale-down: retire from the highest index (arbitrary but
+            # stable), keeping `want` replicas serving
+            victims = [stats[i][0] for i in serving_idx[want:]]
+            for r in victims:
+                try:
+                    ray_tpu.get(r.handle_request.remote(
+                        "unpublish_model", (model,), {}, None), timeout=10)
+                except Exception:
+                    continue
+            deadline = time.time() + GLOBAL_CONFIG.serve_drain_timeout_s
+            pending = list(victims)
+            while pending and time.time() < deadline \
+                    and not self._stop.is_set():
+                still = []
+                for r in pending:
+                    try:
+                        q = ray_tpu.get(r.handle_request.remote(
+                            "model_queue_len", (model,), {}, None),
+                            timeout=5)
+                        if int(q) > 0:
+                            still.append(r)
+                    except Exception:
+                        pass   # dead: drained by definition
+                pending = still
+                if pending:
+                    self._stop.wait(0.2)
+            for r in victims:
+                try:
+                    ray_tpu.get(r.handle_request.remote(
+                        "unload_model", (model,), {}, None), timeout=30)
+                except Exception:
+                    pass
+        finally:
+            self._model_ops.discard((name, model))
+
     def _control_loop(self):
         """Dead-replica replacement + windowed autoscaling."""
         while not self._stop.wait(1.0):
@@ -551,6 +730,10 @@ class ServeController:
                         d["replica_names"] = alive_names
                     self._reconcile(name)
                     continue
+                try:
+                    self._models_tick(name, d)
+                except Exception:
+                    pass   # per-model scaler must never kill the loop
                 if not d["config"].get("autoscaling_config"):
                     continue
                 try:

@@ -147,16 +147,21 @@ class DeploymentHandle:
         return self._router
 
     def options(self, *, multiplexed_model_id: Optional[str] = None,
+                tenant: Optional[str] = None,
                 stream: Optional[bool] = None) -> "DeploymentHandle":
         """Per-call options (ref: handle.options(multiplexed_model_id=...,
         stream=True)). stream=True makes .remote() return an
-        ObjectRefGenerator of the handler's yielded items."""
+        ObjectRefGenerator of the handler's yielded items. tenant tags
+        the call for the router's weighted-fair admission and rides the
+        same context channel as the model id."""
         h = DeploymentHandle(self.deployment_name)
         h._router = self._get_router()     # share router state
         h._context = dict(self._context)
         h._stream = self._stream if stream is None else stream
         if multiplexed_model_id is not None:
             h._context["multiplexed_model_id"] = multiplexed_model_id
+        if tenant is not None:
+            h._context["tenant"] = tenant
         return h
 
     def remote(self, *args, **kwargs):

@@ -997,9 +997,14 @@ class LLMServer:
                  decode_block: int = 8, mode: str = "monolithic",
                  group_pages: Optional[int] = None,
                  retained_groups: Optional[int] = None,
-                 use_directory: bool = True, **kw):
+                 use_directory: bool = True,
+                 multiplexed: bool = False,
+                 max_models: Optional[int] = None,
+                 models: Optional[Dict[str, dict]] = None, **kw):
         if mode not in ("monolithic", "prefill", "decode"):
             raise ValueError(f"unknown LLMServer mode {mode!r}")
+        if multiplexed and mode != "monolithic":
+            raise ValueError("model multiplexing needs mode='monolithic'")
         if mode != "monolithic":
             # disagg handoff is expressed in physical KV pages + chain
             # hashes: contiguous caches have neither
@@ -1020,6 +1025,27 @@ class LLMServer:
         self._adopter = None
         self.engine = LLMEngine(cfg=cfg, params=params, preset=preset,
                                 max_slots=max_slots, eos_token=eos_token, **kw)
+        # --- model multiplexing (serve/multiplex.py) ------------------------
+        # Model id "" (or absent) always means the default engine above;
+        # named models resolve through a _ModelCache of per-model
+        # LLMEngines bounded by serve_max_models_per_replica. The LRU's
+        # unloader parks the evicted engine on `_retiring` so the decode
+        # loop finishes its in-flight generations before dropping it —
+        # evicting a busy model must not kill live streams.
+        self.multiplexed = multiplexed
+        self._engine_kwargs = dict(cfg=cfg, params=params, preset=preset,
+                                   max_slots=max_slots, eos_token=eos_token,
+                                   **kw)
+        self._model_spec: Dict[str, dict] = dict(models or {})
+        self._model_registry = None   # lazy: needs the in-actor runtime
+        self._retiring: List[LLMEngine] = []
+        self._unpublished: set = set()
+        from ray_tpu.serve.multiplex import _ModelCache
+        self._models = _ModelCache(
+            type(self)._load_model,
+            max_models if max_models is not None
+            else _gc.serve_max_models_per_replica,
+            unloader=type(self)._unload_model)
         # fused decode steps per host sync (1 = lowest latency per token,
         # higher = fewer host round-trips; new arrivals wait at most one
         # block for admission)
@@ -1036,18 +1062,135 @@ class LLMServer:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def _engines(self) -> List["LLMEngine"]:
+        """Every engine the decode loop must drive: default + resident
+        multiplexed models + evicted-but-still-busy retirees."""
+        engines = [self.engine]
+        engines.extend(list(self._models.cache.values()))
+        engines.extend(self._retiring)
+        return engines
+
     def _loop(self):
         while not self._stop:
-            if self.engine.has_work():
-                if not self._beacon.busy:
-                    self._beacon.arm(queue=self.queue_len())
-                self.engine.step_n(self.decode_block)
-                self._beacon.tick()
-            else:
+            worked = False
+            for eng in self._engines():
+                if eng.has_work():
+                    if not self._beacon.busy:
+                        self._beacon.arm(queue=self.queue_len())
+                    eng.step_n(self.decode_block)
+                    self._beacon.tick()
+                    worked = True
+            if self._retiring:
+                # a retiree with no admitted work left has finished its
+                # in-flight generations; drop it (engine GC frees pages)
+                self._retiring = [e for e in self._retiring
+                                  if e.has_work()]
+            if not worked:
                 self._beacon.disarm()
                 self._wake.wait(timeout=0.01)
                 self._wake.clear()
         self._beacon.disarm()
+
+    # ---- model multiplexing ------------------------------------------------
+
+    def _registry(self):
+        if self._model_registry is None:
+            from ray_tpu.serve.multiplex import ModelRegistry
+            self._model_registry = ModelRegistry()
+        return self._model_registry
+
+    def _fetch_published(self, model_id: str):
+        """Blocking: resolve published weights from the object store
+        (None if the id was never published — the engine then inits
+        from its preset/spec)."""
+        try:
+            reg = self._registry()
+            if reg.contains(model_id):
+                return reg.fetch(model_id)
+        except Exception:
+            pass
+        return None
+
+    async def _load_model(self, model_id: str) -> "LLMEngine":
+        """_ModelCache loader: build the per-model engine. Weights come
+        from the ModelRegistry when published (one pinned store copy
+        shared by every replica on the node); engine construction (jit
+        compiles) runs off the event loop."""
+        params = await asyncio.to_thread(self._fetch_published, model_id)
+        kw = dict(self._engine_kwargs)
+        kw.update(self._model_spec.get(model_id, {}))
+        if params is not None:
+            kw["params"] = params
+        return await asyncio.to_thread(LLMEngine, **kw)
+
+    def _unload_model(self, model_id: str, engine: "LLMEngine"):
+        """_ModelCache unloader: retire, don't kill — the decode loop
+        keeps driving the engine until its in-flight generations finish,
+        then drops the last reference (page pool + weights free)."""
+        self._retiring.append(engine)
+        self._wake.set()
+
+    async def _engine_for(self, model_id: str) -> "LLMEngine":
+        if not model_id:
+            return self.engine
+        if not self.multiplexed:
+            raise LLMQueueFull(
+                f"replica is not multiplexed; cannot serve model "
+                f"{model_id!r}")
+        eng = await self._models.get(self, model_id)
+        self._wake.set()
+        return eng
+
+    async def load_model(self, model_id: str) -> List[str]:
+        """Controller scale-up entry: warm-load `model_id` on this
+        replica and (re)publish it to the router-visible set."""
+        self._unpublished.discard(model_id)
+        await self._engine_for(model_id)
+        return self.loaded_models()
+
+    def unpublish_model(self, model_id: str) -> bool:
+        """Controller scale-down step 1: stop advertising the model so
+        routers drain away; the engine stays resident until
+        unload_model()."""
+        if model_id in self._models.cache:
+            self._unpublished.add(model_id)
+            return True
+        return False
+
+    async def unload_model(self, model_id: str) -> bool:
+        """Controller scale-down step 2 (after the per-model queue
+        drains): evict the engine through the retiring path."""
+        self._unpublished.discard(model_id)
+        return await self._models.unload(self, model_id)
+
+    def loaded_models(self) -> List[str]:
+        """Models this replica ADVERTISES (resident minus draining) —
+        what rides report_load to the router/controller."""
+        return [m for m in self._models.models()
+                if m not in self._unpublished]
+
+    def model_queue_len(self, model_id: str) -> int:
+        """Backlog of one model's engine (0 if not resident) — the
+        controller's unpublish->drain->unload poll target."""
+        eng = self._models.cache.get(model_id)
+        if eng is None:
+            return 0
+        with eng.lock:
+            return (len(eng.pending)
+                    + sum(1 for s in eng.slots if s is not None))
+
+    def model_stats(self) -> Dict[str, Any]:
+        """Per-model view for the controller's autoscaler tick."""
+        return {
+            "models": self.loaded_models(),
+            "resident": self._models.models(),
+            "queues": {m: self.model_queue_len(m)
+                       for m in self._models.models()},
+            "loads": self._models.load_count,
+            "evictions": self._models.eviction_count,
+            "retiring": len(self._retiring),
+            "draining": self._draining,
+        }
 
     async def __call__(self, request) -> Dict[str, Any]:
         # handle-call payloads arrive as dicts; HTTP POSTs arrive as
@@ -1055,17 +1198,28 @@ class LLMServer:
         if not isinstance(request, dict):
             request = request.json()
         prompt = list(request["prompt"])
+        from ray_tpu.serve.multiplex import get_multiplexed_model_id
+        model = str(request.get("model") or get_multiplexed_model_id() or "")
         try:
             if self._draining:
                 raise LLMQueueFull("replica draining; retry elsewhere")
-            req = self.engine.submit(prompt,
-                                     int(request.get("max_new_tokens", 32)),
-                                     float(request.get("temperature", 0.0)))
+            if model and model in self._unpublished:
+                raise LLMQueueFull(f"model {model!r} draining on this "
+                                   "replica; retry elsewhere")
+            eng = await self._engine_for(model)
+            req = eng.submit(prompt,
+                             int(request.get("max_new_tokens", 32)),
+                             float(request.get("temperature", 0.0)))
         except LLMQueueFull as e:
             from ray_tpu.serve.http_proxy import Response
 
             return Response({"error": str(e)}, status_code=429,
                             headers={"Retry-After": "1"})
+        except Exception as e:
+            from ray_tpu.serve.http_proxy import Response
+
+            return Response({"error": f"model load failed: {e}"},
+                            status_code=500)
         self._wake.set()
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, req.done_event.wait)
@@ -1084,16 +1238,28 @@ class LLMServer:
         `request` is an http_proxy.Request (?stream=1) or a plain dict
         (handle calls)."""
         body = request if isinstance(request, dict) else request.json()
+        from ray_tpu.serve.multiplex import get_multiplexed_model_id
+        model = str(body.get("model") or get_multiplexed_model_id() or "")
         try:
             if self._draining:
                 raise LLMQueueFull("replica draining; retry elsewhere")
-            req = self.engine.submit(list(body["prompt"]),
-                                     int(body.get("max_new_tokens", 32)),
-                                     float(body.get("temperature", 0.0)))
+            if model and model in self._unpublished:
+                raise LLMQueueFull(f"model {model!r} draining on this "
+                                   "replica; retry elsewhere")
+            eng = await self._engine_for(model)
+            req = eng.submit(list(body["prompt"]),
+                             int(body.get("max_new_tokens", 32)),
+                             float(body.get("temperature", 0.0)))
         except LLMQueueFull as e:
             # streaming contract has no status line mid-stream: shed as a
             # typed first frame so clients can back off like on the 429
             yield {"error": str(e), "status": 429, "done": True}
+            return
+        except Exception as e:
+            # model load failed: typed 503 first frame — the router
+            # avoids this replica and retries the stream elsewhere
+            yield {"error": f"model load failed: {e}", "status": 503,
+                   "done": True}
             return
         self._wake.set()
         loop = asyncio.get_running_loop()
@@ -1237,11 +1403,14 @@ class LLMServer:
         in-flight count, so the controller's autoscaler and the LLM
         router's pressure score both see work the engine has ACCEPTED
         but not finished — not just the RPCs currently parked in
-        stream_request."""
-        eng = self.engine
-        with eng.lock:
-            return (len(eng.pending)
-                    + sum(1 for s in eng.slots if s is not None))
+        stream_request. Multiplexed replicas sum across every engine
+        (default + per-model + retiring)."""
+        total = 0
+        for eng in self._engines():
+            with eng.lock:
+                total += (len(eng.pending)
+                          + sum(1 for s in eng.slots if s is not None))
+        return total
 
     def drain(self) -> None:
         """Stop accepting new work; in-flight generations run to
@@ -1264,6 +1433,15 @@ class LLMServer:
             m["max_slots"] = self.engine.max_slots
         m["draining"] = self._draining
         m["mode"] = self.mode
+        if self.multiplexed:
+            # advertised set + per-model backlog: the router folds these
+            # into its stats map (warm-replica routing) and report_load
+            # (per-model autoscaling)
+            m["models"] = self.loaded_models()
+            m["model_queue"] = {mm: self.model_queue_len(mm)
+                                for mm in self._models.models()}
+            m["model_loads"] = self._models.load_count
+            m["model_evictions"] = self._models.eviction_count
         if self._exporter is not None:
             m.update({f"handoff_{k}": v
                       for k, v in self._exporter.stats().items()})

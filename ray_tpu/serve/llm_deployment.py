@@ -49,7 +49,10 @@ class SimLLMServer:
                  group_pages: int = 4,
                  retained_groups: int = 512,
                  use_directory: bool = True,
-                 colocation_interference: float = 0.0):
+                 colocation_interference: float = 0.0,
+                 multiplexed: bool = False,
+                 max_models: Optional[int] = None,
+                 model_load_s: float = 0.05):
         if mode not in ("monolithic", "prefill", "decode"):
             raise ValueError(f"unknown SimLLMServer mode {mode!r}")
         self.mode = mode
@@ -86,6 +89,21 @@ class SimLLMServer:
         self._active = 0
         self._draining = False
         self._lock = threading.Lock()
+        # --- model multiplexing (mirrors LLMServer's contract) --------------
+        # A "loaded model" here is a token dict; loading costs
+        # model_load_s of wall clock — the effect model-affinity routing
+        # exists to avoid (a request landing on a cold replica pays it).
+        self.multiplexed = multiplexed
+        self.model_load_s = float(model_load_s)
+        from ray_tpu.core.config import GLOBAL_CONFIG as _gc
+        from ray_tpu.serve.multiplex import _ModelCache
+        self._models = _ModelCache(
+            type(self)._load_model,
+            max_models if max_models is not None
+            else _gc.serve_max_models_per_replica,
+            unloader=type(self)._unload_model)
+        self._unpublished: set = set()
+        self._model_backlog: Dict[str, int] = {}
         self.metrics: Dict[str, Any] = {
             "requests": 0, "tokens_generated": 0, "rejected": 0,
             "prefix_hits": 0, "prefix_hit_tokens": 0,
@@ -95,7 +113,59 @@ class SimLLMServer:
             "prefills": 0, "prefill_tokens": 0,
             "global_prefix_hits": 0, "global_prefix_hit_tokens": 0,
             "decodes": 0, "handoffs_lost": 0,
-            "interference_stall_s": 0.0}
+            "interference_stall_s": 0.0,
+            # multiplex counters + the per-request context observations
+            # the compiled-vs-legacy propagation test asserts on
+            "model_loads": 0, "model_evictions": 0,
+            "ctx_model_ids": [], "ctx_tenants": []}
+
+    # -- model multiplexing --------------------------------------------------
+
+    async def _load_model(self, model_id: str) -> Dict[str, Any]:
+        await asyncio.sleep(self.model_load_s)
+        with self._lock:
+            self.metrics["model_loads"] += 1
+        return {"model_id": model_id}
+
+    def _unload_model(self, model_id: str, obj) -> None:
+        with self._lock:
+            self.metrics["model_evictions"] += 1
+
+    async def load_model(self, model_id: str) -> List[str]:
+        self._unpublished.discard(model_id)
+        await self._models.get(self, model_id)
+        return self.loaded_models()
+
+    def unpublish_model(self, model_id: str) -> bool:
+        if model_id in self._models.cache:
+            self._unpublished.add(model_id)
+            return True
+        return False
+
+    async def unload_model(self, model_id: str) -> bool:
+        self._unpublished.discard(model_id)
+        return await self._models.unload(self, model_id)
+
+    def loaded_models(self) -> List[str]:
+        return [m for m in self._models.models()
+                if m not in self._unpublished]
+
+    def model_queue_len(self, model_id: str) -> int:
+        with self._lock:
+            return self._model_backlog.get(model_id, 0)
+
+    def model_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            queues = dict(self._model_backlog)
+        return {
+            "models": self.loaded_models(),
+            "resident": self._models.models(),
+            "queues": queues,
+            "loads": self.metrics["model_loads"],
+            "evictions": self.metrics["model_evictions"],
+            "retiring": 0,
+            "draining": self._draining,
+        }
 
     # -- disagg plumbing (mode="prefill" / "decode") -------------------------
 
@@ -177,10 +247,21 @@ class SimLLMServer:
     # -- serving contract ----------------------------------------------------
 
     async def stream_request(self, request) -> Any:
+        from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
+                                             get_request_tenant)
         body = request if isinstance(request, dict) else request.json()
         prompt = list(body["prompt"])
         max_new = int(body.get("max_new_tokens", 32))
+        model = str(body.get("model") or get_multiplexed_model_id() or "")
         with self._lock:
+            # record the context this call actually observed (the
+            # compiled-vs-legacy propagation test reads these; bounded)
+            for k, v in (("ctx_model_ids", get_multiplexed_model_id()),
+                         ("ctx_tenants", get_request_tenant())):
+                lst = self.metrics[k]
+                lst.append(v)
+                if len(lst) > 512:
+                    del lst[:-256]
             backlog = self._pending + self._active
             if self._draining or (self.max_queue_depth is not None
                                   and backlog >= self.max_queue_depth):
@@ -189,11 +270,35 @@ class SimLLMServer:
             else:
                 self.metrics["requests"] += 1
                 self._pending += 1
+                if model:
+                    self._model_backlog[model] = \
+                        self._model_backlog.get(model, 0) + 1
                 shed = False
-        if shed:
-            yield {"error": "sim queue full" if not self._draining
-                   else "replica draining", "status": 429, "done": True}
+        if shed or (model and model in self._unpublished):
+            if not shed:   # admitted above, roll back before shedding
+                with self._lock:
+                    self._pending -= 1
+                    self._model_backlog[model] -= 1
+                    self.metrics["requests"] -= 1
+                    self.metrics["rejected"] += 1
+            yield {"error": (f"model {model!r} draining on this replica"
+                             if not shed else
+                             "sim queue full" if not self._draining
+                             else "replica draining"),
+                   "status": 429, "done": True}
             return
+        if model and self.multiplexed:
+            try:
+                # cold replicas pay the load here — the wall-clock cost
+                # model-affinity routing avoids on warm replicas
+                await self._models.get(self, model)
+            except Exception as e:
+                with self._lock:
+                    self._pending -= 1
+                    self._model_backlog[model] -= 1
+                yield {"error": f"model load failed: {e}", "status": 503,
+                       "done": True}
+                return
         t_sub = time.time()
         async with self._slots:
             with self._lock:
@@ -241,6 +346,9 @@ class SimLLMServer:
             finally:
                 with self._lock:
                     self._active -= 1
+                    if model:
+                        self._model_backlog[model] = max(
+                            0, self._model_backlog.get(model, 0) - 1)
 
     async def prefill_request(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """mode="prefill": run (only) the prefill for `body["prompt"]`,
@@ -391,6 +499,10 @@ class SimLLMServer:
             m["max_slots"] = self.max_slots
             m["draining"] = self._draining
             m["mode"] = self.mode
+            if self.multiplexed:
+                m["model_queue"] = dict(self._model_backlog)
+        if self.multiplexed:
+            m["models"] = self.loaded_models()
         if m["ttft_count"]:
             m["mean_ttft_s"] = m["ttft_sum"] / m["ttft_count"]
         if self._exporter is not None:
@@ -417,6 +529,8 @@ def build_llm_app(*, name: str = "llm_server",
                   num_replicas: int = 2,
                   router_policy: str = "affinity",
                   autoscaling_config: Optional[dict] = None,
+                  model_autoscaling_config: Optional[dict] = None,
+                  tenant_weights: Optional[dict] = None,
                   use_sim: bool = False,
                   router_kwargs: Optional[dict] = None,
                   disaggregated: bool = False,
@@ -464,8 +578,13 @@ def build_llm_app(*, name: str = "llm_server",
         return router
     llm = serve_api.deployment(
         server_cls, name=name, num_replicas=num_replicas,
-        autoscaling_config=autoscaling_config).bind(**llm_kwargs)
+        autoscaling_config=autoscaling_config,
+        model_autoscaling_config=model_autoscaling_config).bind(
+        **llm_kwargs)
+    rkw = dict(router_kwargs or {})
+    if tenant_weights is not None:
+        rkw.setdefault("tenant_weights", tenant_weights)
     router = serve_api.deployment(
         LLMRouter, name=f"{name}_router", num_replicas=1).bind(
-        llm, policy=router_policy, **(router_kwargs or {}))
+        llm, policy=router_policy, **rkw)
     return router

@@ -110,12 +110,23 @@ class LLMRouter:
                  stats_interval_s: Optional[float] = None,
                  report_load: bool = True,
                  max_attempts: int = 6,
-                 compiled_hop: Optional[bool] = None):
+                 compiled_hop: Optional[bool] = None,
+                 tenant_weights: Optional[Dict[str, float]] = None):
         if policy not in ("affinity", "p2c", "random"):
             raise ValueError(f"unknown routing policy {policy!r}")
         self._handle = llm_handle
         self.policy = policy
         cfg = GLOBAL_CONFIG
+        # Weighted-fair tenant admission: explicit arg wins, else the
+        # serve_tenant_weights JSON knob; unmapped tenants weigh 1.
+        if tenant_weights is None and cfg.serve_tenant_weights:
+            import json as _json
+            try:
+                tenant_weights = _json.loads(cfg.serve_tenant_weights)
+            except Exception:
+                tenant_weights = None
+        self.tenant_weights: Dict[str, float] = {
+            str(k): float(v) for k, v in (tenant_weights or {}).items()}
         self._compiled_hop = (compiled_hop if compiled_hop is not None
                               else cfg.llm_router_compiled_hop)
         #: replica key -> CompiledDAG of the standing stream-frame hop
@@ -134,12 +145,19 @@ class LLMRouter:
         self._lock = threading.Lock()
         self._inflight: Dict[str, int] = {}   # per-replica, router-local
         self._total_inflight = 0
+        #: per-tenant / per-model in-flight splits of _total_inflight
+        self._tenant_inflight: Dict[str, int] = {}
+        self._model_inflight: Dict[str, int] = {}
+        #: per-tenant admit/shed/TTFT aggregates (stats() + bench)
+        self._tenant_stats: Dict[str, Dict[str, float]] = {}
         #: per-replica view from the stats poll thread:
-        #: {pending, active, draining, busy, _raw_busy_s, _ts}
+        #: {pending, active, draining, busy, models, model_queue, ...}
         self._replica_stats: Dict[str, Dict[str, Any]] = {}
         self.counters = {"requests": 0, "shed": 0, "replica_shed": 0,
+                         "tenant_shed": 0,
                          "reroutes": 0, "affinity_picks": 0,
-                         "fallback_picks": 0, "compiled_streams": 0,
+                         "fallback_picks": 0, "warm_model_picks": 0,
+                         "cold_model_picks": 0, "compiled_streams": 0,
                          "legacy_streams": 0}
         try:
             me = (ray_tpu.get_runtime_context().get_actor_id() or "driver")
@@ -170,6 +188,21 @@ class LLMRouter:
             "router-observed time to first token",
             boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30],
             tag_keys=("router",)).set_default_tags(tag)
+        # per-tenant telemetry: the tenant tag splits each series so the
+        # dashboard/bench can see WHO was admitted, shed, and how slow
+        self._m_tenant_requests = _um.Counter(
+            "ray_tpu_serve_tenant_requests",
+            "requests admitted per tenant",
+            tag_keys=("router", "tenant")).set_default_tags(tag)
+        self._m_tenant_sheds = _um.Counter(
+            "ray_tpu_serve_tenant_sheds",
+            "requests shed per tenant by weighted-fair admission",
+            tag_keys=("router", "tenant")).set_default_tags(tag)
+        self._m_tenant_ttft = _um.Histogram(
+            "ray_tpu_serve_tenant_ttft_s",
+            "per-tenant router-observed time to first token",
+            boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30],
+            tag_keys=("router", "tenant")).set_default_tags(tag)
         # Dedicated executor for blocking stream pulls: every in-flight
         # stream PARKS a thread in _next_item waiting for the replica's
         # next frame, so the event loop's small default pool would cap
@@ -238,6 +271,10 @@ class LLMRouter:
                     "active": int(raw.get("active_slots", 0)),
                     "draining": bool(raw.get("draining", False)),
                     "busy": min(ewma, 4.0),
+                    # advertised model set + per-model backlog from
+                    # multiplexed replicas (absent -> single-model)
+                    "models": list(raw.get("models") or []),
+                    "model_queue": dict(raw.get("model_queue") or {}),
                     "_raw_busy_s": busy_s, "_ts": now,
                 }
         with self._lock:
@@ -247,16 +284,20 @@ class LLMRouter:
                     del stats_map[k]
         return live
 
-    def _report(self, deployment_name: str, depth: int) -> None:
+    def _report(self, deployment_name: str, depth: int,
+                model_depths: Optional[Dict[str, int]] = None) -> None:
         """Push one pool's router-observed queue depth to the controller
-        so autoscaling sees demand the replicas haven't accepted yet."""
+        so autoscaling sees demand the replicas haven't accepted yet.
+        model_depths carries the per-model split feeding the controller's
+        per-model replica scaler."""
         if not self._report_load:
             return
         try:
             controller = ray_tpu.get_actor("_serve_controller",
                                            namespace="serve")
             ray_tpu.get(controller.report_load.remote(
-                deployment_name, self._reporter, depth), timeout=5)
+                deployment_name, self._reporter, depth, model_depths),
+                timeout=5)
         except Exception:
             pass   # controller restarting: next tick re-reports
 
@@ -278,18 +319,29 @@ class LLMRouter:
             for k, _ in stale:
                 del self._compiled[k]
             depth = self._total_inflight
+            mdepth = {m: v for m, v in self._model_inflight.items()
+                      if v > 0}
         for _, comp in stale:   # off-lock: teardown RPCs block
             try:
                 comp.teardown(kill_actors=False)
             except Exception:
                 pass
-        self._report(self._handle.deployment_name, depth)
+        # always send the dict (even empty): a None would leave the
+        # controller holding this reporter's LAST split for up to its
+        # 10 s age-out, pinning per-model demand that already drained
+        self._report(self._handle.deployment_name, depth, mdepth)
 
     # ---- placement ---------------------------------------------------------
 
-    def _pick(self, prompt: List[int], avoid: set) -> Tuple[str, Any]:
+    def _pick(self, prompt: List[int], model: str,
+              avoid: set) -> Tuple[str, Any]:
         """Choose a replica (blocking; call from an executor thread).
-        avoid = replicas that already shed this request."""
+        avoid = replicas that already shed this request. The rendezvous
+        key is (model_id, prefix): all traffic for one model converges on
+        the same sub-ranking, and within it shared prefixes converge
+        further. Replicas ADVERTISING the model (loaded + published) are
+        stably promoted ahead of cold ones so the overload walk prefers
+        paying queueing over paying a model load."""
         import random
 
         reps = self._snapshot()
@@ -316,52 +368,128 @@ class LLMRouter:
                 return usable[a if self._pressure(ka)
                               <= self._pressure(kb) else b]
             ph = prefix_hash(prompt, self.prefix_tokens)
+            rkey = f"{model}\x00{ph}" if model else ph
             ranked = sorted(
                 usable, key=lambda kr: hashlib.sha1(
-                    f"{ph}:{kr[0]}".encode()).digest(), reverse=True)
+                    f"{rkey}:{kr[0]}".encode()).digest(), reverse=True)
+            if model:
+                # stable warm-first partition (rendezvous order kept
+                # within each half): a replica with the model resident
+                # skips the load entirely
+                warm_keys = {k for k, _ in ranked
+                             if model in (stats.get(k, {}).get("models")
+                                          or [])}
+                if warm_keys:
+                    ranked = ([kr for kr in ranked if kr[0] in warm_keys]
+                              + [kr for kr in ranked
+                                 if kr[0] not in warm_keys])
+            else:
+                warm_keys = set()
             mean = sum(self._pressure(k) for k, _ in usable) / len(usable)
             limit = self.overload_factor * max(mean, 1.0)
+            chosen = None
+            chosen_rank = 0
             for rank, (k, r) in enumerate(ranked):
                 if self._pressure(k) <= limit:
-                    with self._lock:
-                        if rank == 0:
-                            self.counters["affinity_picks"] += 1
-                        else:
-                            self.counters["fallback_picks"] += 1
-                    if rank == 0:
-                        self._m_affinity.inc()
-                    return k, r
+                    chosen, chosen_rank = (k, r), rank
+                    break
+            if chosen is None:
+                chosen = min(ranked, key=lambda kr: self._pressure(kr[0]))
+                chosen_rank = -1
             with self._lock:
-                self.counters["fallback_picks"] += 1
-            return min(ranked, key=lambda kr: self._pressure(kr[0]))
+                if chosen_rank == 0:
+                    self.counters["affinity_picks"] += 1
+                else:
+                    self.counters["fallback_picks"] += 1
+                if model:
+                    if chosen[0] in warm_keys:
+                        self.counters["warm_model_picks"] += 1
+                    else:
+                        self.counters["cold_model_picks"] += 1
+            if chosen_rank == 0:
+                self._m_affinity.inc()
+            return chosen
+
+    # ---- weighted-fair tenant admission ------------------------------------
+
+    def _tenant_weight(self, tenant: str) -> float:
+        return float(self.tenant_weights.get(tenant, 1.0))
+
+    def _tenant_share_locked(self, tenant: str) -> float:
+        """`tenant`'s guaranteed slice of max_inflight: weights are
+        normalized over the tenants ACTIVE right now (plus the asker),
+        so idle tenants do not strand capacity. Caller holds _lock."""
+        active = {t for t, v in self._tenant_inflight.items() if v > 0}
+        active.add(tenant)
+        wsum = sum(self._tenant_weight(t) for t in active)
+        return self.max_inflight * self._tenant_weight(tenant) \
+            / max(wsum, 1e-9)
+
+    def _admit_locked(self, tenant: str) -> bool:
+        """Weighted-fair queuing over in-flight shares. A tenant within
+        its guaranteed share ALWAYS admits — even with the global cap
+        transiently exceeded by another tenant's borrowing (overshoot is
+        bounded by the sum of guaranteed shares = max_inflight). Past
+        its share, a tenant may only borrow idle capacity under the
+        global cap — so when the router saturates, the most-over-quota
+        tenant is exactly the one shed first."""
+        cur = self._tenant_inflight.get(tenant, 0)
+        if cur + 1 <= self._tenant_share_locked(tenant):
+            return True
+        return self._total_inflight < self.max_inflight
+
+    def _tenant_stat(self, tenant: str) -> Dict[str, float]:
+        return self._tenant_stats.setdefault(
+            tenant, {"requests": 0, "shed": 0,
+                     "ttft_sum": 0.0, "ttft_count": 0})
 
     # ---- request paths -----------------------------------------------------
 
     async def stream_request(self, request) -> Any:
         """End-to-end streaming entry (HTTP ?stream=1 / SSE, or handle
-        calls): admission -> placement -> fan the replica's token frames
-        through, surviving replica death mid-stream by re-routing with
-        prompt + generated-so-far."""
+        calls): weighted-fair admission -> model/prefix placement -> fan
+        the replica's token frames through, surviving replica death
+        mid-stream by re-routing with prompt + generated-so-far. The
+        model id and tenant tag come from the body ("model"/"tenant")
+        or, for handle calls via .options(), the call context."""
+        from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
+                                             get_request_tenant)
         body = request if isinstance(request, dict) else request.json()
         prompt = list(body["prompt"])
         max_new = int(body.get("max_new_tokens", 32))
         temperature = float(body.get("temperature", 0.0))
+        model = str(body.get("model") or get_multiplexed_model_id() or "")
+        tenant = str(body.get("tenant") or get_request_tenant()
+                     or "default")
         with self._lock:
-            if self._total_inflight >= self.max_inflight:
+            if not self._admit_locked(tenant):
                 self.counters["shed"] += 1
+                self.counters["tenant_shed"] += 1
+                self._tenant_stat(tenant)["shed"] += 1
                 shed = True
             else:
                 self._total_inflight += 1
+                self._tenant_inflight[tenant] = \
+                    self._tenant_inflight.get(tenant, 0) + 1
+                if model:
+                    self._model_inflight[model] = \
+                        self._model_inflight.get(model, 0) + 1
                 self.counters["requests"] += 1
+                self._tenant_stat(tenant)["requests"] += 1
                 shed = False
             self._m_inflight.set(self._total_inflight)
         if shed:
             self._m_sheds.inc()
-            yield {"error": f"router at max_inflight={self.max_inflight}; "
+            self._m_tenant_sheds.inc(tags={"tenant": tenant})
+            yield {"error": f"tenant {tenant!r} over fair share at "
+                            f"max_inflight={self.max_inflight}; "
                             "retry later",
                    "status": 429, "retry_after_s": 1.0, "done": True}
             return
         self._m_requests.inc()
+        self._m_tenant_requests.inc(tags={"tenant": tenant})
+        ctx = ({"multiplexed_model_id": model, "tenant": tenant}
+               if (model or tenant != "default") else None)
         loop = asyncio.get_running_loop()
         t0 = time.time()
         first_t: Optional[float] = None
@@ -378,7 +506,7 @@ class LLMRouter:
                     return
                 try:
                     key, replica = await loop.run_in_executor(
-                        self._executor, self._pick, prompt, avoid)
+                        self._executor, self._pick, prompt, model, avoid)
                 except RuntimeError as e:
                     yield {"error": str(e), "status": 503, "done": True,
                            "n_tokens": len(emitted)}
@@ -386,13 +514,17 @@ class LLMRouter:
                 sub = {"prompt": prompt + emitted,
                        "max_new_tokens": max_new - len(emitted),
                        "temperature": temperature}
+                if model:
+                    sub["model"] = model
+                if tenant != "default":
+                    sub["tenant"] = tenant
                 with self._lock:
                     self._inflight[key] = self._inflight.get(key, 0) + 1
                 rerouted = False
                 try:
                     frames = await loop.run_in_executor(
                         self._executor, self._open_stream, key, replica,
-                        (sub,))
+                        (sub,), "stream_request", ctx)
                     while True:
                         try:
                             item = await loop.run_in_executor(
@@ -428,7 +560,14 @@ class LLMRouter:
                         if toks:
                             if first_t is None:
                                 first_t = time.time()
-                                self._m_ttft.observe(first_t - t0)
+                                ttft = first_t - t0
+                                self._m_ttft.observe(ttft)
+                                self._m_tenant_ttft.observe(
+                                    ttft, tags={"tenant": tenant})
+                                with self._lock:
+                                    st = self._tenant_stat(tenant)
+                                    st["ttft_sum"] += ttft
+                                    st["ttft_count"] += 1
                             emitted.extend(toks)
                             yield {"tokens": toks}
                 finally:
@@ -440,23 +579,38 @@ class LLMRouter:
         finally:
             with self._lock:
                 self._total_inflight = max(self._total_inflight - 1, 0)
+                # drop zeroed entries: the split dicts stay bounded by
+                # ACTIVE tenants/models, not the lifetime catalog
+                if self._tenant_inflight.get(tenant, 0) > 1:
+                    self._tenant_inflight[tenant] -= 1
+                else:
+                    self._tenant_inflight.pop(tenant, None)
+                if model:
+                    if self._model_inflight.get(model, 0) > 1:
+                        self._model_inflight[model] -= 1
+                    else:
+                        self._model_inflight.pop(model, None)
                 self._m_inflight.set(self._total_inflight)
 
     # ---- stream transport --------------------------------------------------
 
     def _open_stream(self, key: str, replica, args: tuple,
-                     method: str = "stream_request"):
+                     method: str = "stream_request",
+                     context: Optional[dict] = None):
         """Open one replica stream (blocking; executor thread). Compiled
         hop when enabled: a raw enqueue onto the replica's standing
         channel; otherwise the per-call dispatch path. The method is an
         execute-time input on the standing graph, so the SAME channel
         per replica carries any streaming method — stream_request for
-        the monolithic pool, adopt_decode for the disagg decode hop."""
+        the monolithic pool, adopt_decode for the disagg decode hop.
+        `context` (multiplexed_model_id / tenant) is an execute-time
+        input too, so BOTH hops deliver identical per-call context to
+        the replica's contextvars."""
         if self._compiled_hop:
             try:
                 comp = self._compiled_for(key, replica)
                 ref = comp.execute(method=method, args=args,
-                                   kwargs={}, context=None)
+                                   kwargs={}, context=context)
                 with self._lock:
                     self.counters["compiled_streams"] += 1
                 return iter(ref)
@@ -470,7 +624,7 @@ class LLMRouter:
         with self._lock:
             self.counters["legacy_streams"] += 1
         gen = replica.handle_request_streaming.remote(
-            method, args, {}, None)
+            method, args, {}, context)
         return _legacy_frames(gen)
 
     def _compiled_for(self, key: str, replica):
@@ -557,6 +711,11 @@ class LLMRouter:
                     "policy": self.policy,
                     "total_inflight": self._total_inflight,
                     "inflight": dict(self._inflight),
+                    "tenant_weights": dict(self.tenant_weights),
+                    "tenant_inflight": dict(self._tenant_inflight),
+                    "model_inflight": dict(self._model_inflight),
+                    "tenant_stats": {t: dict(v) for t, v in
+                                     self._tenant_stats.items()},
                     "replica_stats": {
                         k: {kk: vv for kk, vv in v.items()
                             if not kk.startswith("_")}

@@ -5,6 +5,13 @@ max_num_models_per_replica models per replica keyed by the model id that the
 caller sets via handle.options(multiplexed_model_id=...); the loader is the
 decorated (async) method; serve.get_multiplexed_model_id() reads the id of
 the current request.
+
+Beyond the reference shape, this module carries the fleet layer's
+per-request context (the tenant tag rides the same contextvar channel as
+the model id) and the ModelRegistry: model weights are published ONCE
+into the object store and resolved by model id through the GCS KV, so N
+replicas on a node share one pinned zero-copy reading of the blob and
+cold-model eviction costs nothing the spill tier can't restore.
 """
 
 from __future__ import annotations
@@ -12,11 +19,17 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import functools
+import itertools
+import pickle
 from collections import OrderedDict
-from typing import Callable, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.util import metrics as _um
 
 _model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "serve_multiplexed_model_id", default="")
+_tenant: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_request_tenant", default="")
 
 
 def get_multiplexed_model_id() -> str:
@@ -28,13 +41,61 @@ def _set_multiplexed_model_id(model_id: str):
     _model_id.set(model_id or "")
 
 
+def get_request_tenant() -> str:
+    """Tenant tag of the current call ('' if unset)."""
+    return _tenant.get()
+
+
+def _set_request_tenant(tenant: str):
+    _tenant.set(tenant or "")
+
+
+_cache_seq = itertools.count()
+
+# Module-held instruments (the metrics registry is weak — instruments
+# owned here outlive any one cache). Series split per cache via the tag.
+_m_loaded = _um.Gauge(
+    "ray_tpu_serve_models_loaded",
+    "models resident in a replica's multiplex LRU",
+    tag_keys=("cache",))
+_m_evictions = _um.Counter(
+    "ray_tpu_serve_model_evictions",
+    "LRU evictions from replicas' multiplex caches",
+    tag_keys=("cache",))
+
+
 class _ModelCache:
-    def __init__(self, loader: Callable, max_models: int):
+    """Async LRU of loaded models with in-flight load dedup.
+
+    Concurrent get()s of the same cold model share ONE loader call via a
+    future; a loader failure wakes every waiter with the exception and
+    leaves the id retryable. Eviction (LRU overflow or explicit
+    unload()) runs the `unloader` hook so the evicted engine releases
+    its page pool / device memory instead of leaking until GC.
+    """
+
+    def __init__(self, loader: Callable, max_models: int,
+                 unloader: Optional[Callable] = None, name: str = ""):
         self.loader = loader
+        self.unloader = unloader
         self.max_models = max_models
         self.cache: OrderedDict = OrderedDict()
         self.loading: dict = {}   # model_id -> Future (in-flight dedup)
         self.lock = asyncio.Lock()
+        self.name = name or f"cache-{next(_cache_seq)}"
+        self._tags = {"cache": self.name}
+        self.load_count = 0
+        self.eviction_count = 0
+
+    def models(self) -> List[str]:
+        """Loaded model ids, LRU-first."""
+        return list(self.cache.keys())
+
+    def snapshot_items(self) -> List[Tuple[str, Any]]:
+        return list(self.cache.items())
+
+    def __contains__(self, model_id: str) -> bool:
+        return model_id in self.cache
 
     async def get(self, owner, model_id: str):
         async with self.lock:
@@ -57,33 +118,71 @@ class _ModelCache:
                 out = await out
         except BaseException as e:
             async with self.lock:
+                # clear the in-flight entry AND wake waiters with the
+                # failure in one critical section — a waiter arriving
+                # between the two would otherwise hang on an orphaned
+                # future while the id looks retryable
                 self.loading.pop(model_id, None)
-            if not fut.done():
-                fut.set_exception(e)
+                if not fut.done():
+                    fut.set_exception(e)
+                if fut.done() and not fut.cancelled():
+                    fut.exception()   # consume: no "never retrieved"
+                                      # warning when no waiter shows up
             raise
+        evicted: List[Tuple[str, Any]] = []
         async with self.lock:
             self.cache[model_id] = out
             self.cache.move_to_end(model_id)
             self.loading.pop(model_id, None)
             while len(self.cache) > self.max_models:
-                _, evicted = self.cache.popitem(last=False)
-                # best-effort unload hook (ref: __del__-based unload)
-                unload = getattr(evicted, "__serve_unload__", None)
-                if callable(unload):
-                    try:
-                        maybe = unload()
-                        if asyncio.iscoroutine(maybe):
-                            await maybe
-                    except Exception:
-                        pass
+                evicted.append(self.cache.popitem(last=False))
+            self.load_count += 1
+            _m_loaded.set(len(self.cache), tags=self._tags)
+        for mid, obj in evicted:
+            await self._run_unloader(owner, mid, obj)
         if not fut.done():
             fut.set_result(out)
         return out
 
+    async def unload(self, owner, model_id: str) -> bool:
+        """Explicitly evict one model (controller scale-down path)."""
+        async with self.lock:
+            obj = self.cache.pop(model_id, None)
+            if obj is not None:
+                _m_loaded.set(len(self.cache), tags=self._tags)
+        if obj is None:
+            return False
+        await self._run_unloader(owner, model_id, obj)
+        return True
+
+    async def _run_unloader(self, owner, model_id: str, obj):
+        self.eviction_count += 1
+        _m_evictions.inc(tags=self._tags)
+        _m_loaded.set(len(self.cache), tags=self._tags)
+        if self.unloader is not None:
+            try:
+                maybe = self.unloader(owner, model_id, obj)
+                if asyncio.iscoroutine(maybe):
+                    await maybe
+            except Exception:
+                pass
+        # best-effort legacy unload hook (ref: __del__-based unload)
+        unload = getattr(obj, "__serve_unload__", None)
+        if callable(unload):
+            try:
+                maybe = unload()
+                if asyncio.iscoroutine(maybe):
+                    await maybe
+            except Exception:
+                pass
+
 
 def multiplexed(func: Optional[Callable] = None, *,
-                max_num_models_per_replica: int = 3):
-    """Decorator for the per-replica model loader method."""
+                max_num_models_per_replica: int = 3,
+                unloader: Optional[Callable] = None):
+    """Decorator for the per-replica model loader method. `unloader`,
+    if given, is called as unloader(self, model_id, model) when the LRU
+    evicts a model."""
 
     def deco(loader: Callable):
         cache_attr = f"__serve_multiplex_cache_{loader.__name__}"
@@ -92,7 +191,8 @@ def multiplexed(func: Optional[Callable] = None, *,
         async def wrapper(self, model_id: str):
             cache = getattr(self, cache_attr, None)
             if cache is None:
-                cache = _ModelCache(loader, max_num_models_per_replica)
+                cache = _ModelCache(loader, max_num_models_per_replica,
+                                    unloader=unloader)
                 setattr(self, cache_attr, cache)
             return await cache.get(self, model_id)
 
@@ -101,3 +201,49 @@ def multiplexed(func: Optional[Callable] = None, *,
     if func is not None:
         return deco(func)
     return deco
+
+
+_REGISTRY_NS = "serve_models"
+
+
+class ModelRegistry:
+    """Fleet-wide model-weights registry over the object store.
+
+    publish() puts the weights blob once and maps model_id -> pickled
+    ObjectRef in the GCS KV; fetch() on any node resolves the ref — a
+    zero-copy local read when a copy is already node-resident, so N
+    replicas on one node share a single pinned copy instead of N
+    deserialized clones. The publisher keeps its ref alive in
+    `_published` (the pin); evicted/spilled copies restore transparently
+    through the store's spill tier, which is what makes cold-model LRU
+    eviction on replicas free.
+    """
+
+    def __init__(self):
+        from ray_tpu.core import runtime as _rt
+        self._rt = _rt.get_runtime()
+        self._published: Dict[str, Any] = {}   # model_id -> ObjectRef pin
+
+    def publish(self, model_id: str, weights: Any):
+        """Put `weights` into the object store and register the ref
+        under `model_id`. Returns the ObjectRef."""
+        import ray_tpu
+        ref = ray_tpu.put(weights)
+        self._published[model_id] = ref
+        self._rt.kv_put(_REGISTRY_NS, model_id.encode(), pickle.dumps(ref))
+        return ref
+
+    def contains(self, model_id: str) -> bool:
+        return self._rt.kv_get(_REGISTRY_NS, model_id.encode()) is not None
+
+    def ref(self, model_id: str):
+        raw = self._rt.kv_get(_REGISTRY_NS, model_id.encode())
+        if raw is None:
+            raise KeyError(f"model {model_id!r} is not published")
+        return pickle.loads(raw)
+
+    def fetch(self, model_id: str, timeout: Optional[float] = 30.0) -> Any:
+        """Resolve the published weights for `model_id` (KeyError if the
+        id was never published)."""
+        import ray_tpu
+        return ray_tpu.get(self.ref(model_id), timeout=timeout)
