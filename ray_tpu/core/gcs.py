@@ -1057,7 +1057,10 @@ class GcsServer:
 
     async def _publish(self, channel: str, message: Any):
         dead = []
-        for addr in self.subscribers.get(channel, ()):  # push model
+        # snapshot: subscribe/unsubscribe coroutines can mutate the set
+        # while the oneway push awaits ("Set changed size during
+        # iteration" otherwise)
+        for addr in tuple(self.subscribers.get(channel, ())):  # push model
             try:
                 await self.pool.get(addr).oneway("pubsub_message",
                                                 channel=channel, message=message)

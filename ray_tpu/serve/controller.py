@@ -17,6 +17,7 @@ checkpoints, controller.py:74-79).
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 import uuid
@@ -623,7 +624,10 @@ class ServeController:
                 hist.pop(0)
             avg = sum(v for _, v in hist) / max(len(hist), 1)
             cur = len(serving.get(m, []))
-            want = max(mn, min(mx, int((avg + per - 1) // per) or mn))
+            # math.ceil, not the integer (a+b-1)//b idiom: `per` is a
+            # float knob and fractional targets must still round UP
+            want = math.ceil(avg / per) if per > 0 else mx
+            want = max(mn, min(mx, want))
             table[m] = {"serving": cur, "want": want, "load": load,
                         "avg_load": avg}
             if want == cur:

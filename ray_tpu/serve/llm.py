@@ -19,12 +19,15 @@ batch composition; bucketed compilation"):
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger("ray_tpu.serve.llm")
 
 
 class LLMQueueFull(Exception):
@@ -1038,6 +1041,11 @@ class LLMServer:
                                    **kw)
         self._model_spec: Dict[str, dict] = dict(models or {})
         self._model_registry = None   # lazy: needs the in-actor runtime
+        # `_retiring` is shared between the event-loop thread (unloader
+        # appends) and the decode thread (filter-reassign): both sides
+        # take this lock, or an engine appended mid-filter is lost and
+        # its in-flight streams never step again
+        self._retire_lock = threading.Lock()
         self._retiring: List[LLMEngine] = []
         self._unpublished: set = set()
         from ray_tpu.serve.multiplex import _ModelCache
@@ -1064,27 +1072,41 @@ class LLMServer:
 
     def _engines(self) -> List["LLMEngine"]:
         """Every engine the decode loop must drive: default + resident
-        multiplexed models + evicted-but-still-busy retirees."""
+        multiplexed models + evicted-but-still-busy retirees. Runs on
+        the decode thread while the event loop loads/evicts models, so
+        it reads the cache's immutable snapshot — never the live
+        OrderedDict."""
         engines = [self.engine]
-        engines.extend(list(self._models.cache.values()))
-        engines.extend(self._retiring)
+        engines.extend(self._models.values_snapshot())
+        with self._retire_lock:
+            engines.extend(self._retiring)
         return engines
 
     def _loop(self):
         while not self._stop:
             worked = False
-            for eng in self._engines():
-                if eng.has_work():
-                    if not self._beacon.busy:
-                        self._beacon.arm(queue=self.queue_len())
-                    eng.step_n(self.decode_block)
-                    self._beacon.tick()
-                    worked = True
-            if self._retiring:
-                # a retiree with no admitted work left has finished its
-                # in-flight generations; drop it (engine GC frees pages)
-                self._retiring = [e for e in self._retiring
-                                  if e.has_work()]
+            try:
+                for eng in self._engines():
+                    if eng.has_work():
+                        if not self._beacon.busy:
+                            self._beacon.arm(queue=self.queue_len())
+                        eng.step_n(self.decode_block)
+                        self._beacon.tick()
+                        worked = True
+                if self._retiring:
+                    # a retiree with no admitted work left has finished
+                    # its in-flight generations; drop it (engine GC
+                    # frees pages)
+                    with self._retire_lock:
+                        self._retiring = [e for e in self._retiring
+                                          if e.has_work()]
+            except Exception:
+                # one engine's bad step must not kill the decode thread
+                # — that would freeze every stream on the replica, not
+                # just the failing one
+                logger.exception("decode loop step failed; continuing")
+                time.sleep(0.05)
+                continue
             if not worked:
                 self._beacon.disarm()
                 self._wake.wait(timeout=0.01)
@@ -1100,16 +1122,16 @@ class LLMServer:
         return self._model_registry
 
     def _fetch_published(self, model_id: str):
-        """Blocking: resolve published weights from the object store
-        (None if the id was never published — the engine then inits
-        from its preset/spec)."""
-        try:
-            reg = self._registry()
-            if reg.contains(model_id):
-                return reg.fetch(model_id)
-        except Exception:
-            pass
-        return None
+        """Blocking: resolve published weights from the object store.
+        Returns None ONLY when the id is genuinely unpublished (the
+        engine then inits from its preset/spec). Registry or fetch
+        failures propagate so the load fails loudly — a transient store
+        timeout must not silently serve default weights under the
+        requested model id."""
+        reg = self._registry()
+        if not reg.contains(model_id):
+            return None
+        return reg.fetch(model_id)
 
     async def _load_model(self, model_id: str) -> "LLMEngine":
         """_ModelCache loader: build the per-model engine. Weights come
@@ -1127,7 +1149,8 @@ class LLMServer:
         """_ModelCache unloader: retire, don't kill — the decode loop
         keeps driving the engine until its in-flight generations finish,
         then drops the last reference (page pool + weights free)."""
-        self._retiring.append(engine)
+        with self._retire_lock:
+            self._retiring.append(engine)
         self._wake.set()
 
     async def _engine_for(self, model_id: str) -> "LLMEngine":

@@ -52,7 +52,8 @@ class SimLLMServer:
                  colocation_interference: float = 0.0,
                  multiplexed: bool = False,
                  max_models: Optional[int] = None,
-                 model_load_s: float = 0.05):
+                 model_load_s: float = 0.05,
+                 model_load_fail_ids: Optional[List[str]] = None):
         if mode not in ("monolithic", "prefill", "decode"):
             raise ValueError(f"unknown SimLLMServer mode {mode!r}")
         self.mode = mode
@@ -95,6 +96,9 @@ class SimLLMServer:
         # exists to avoid (a request landing on a cold replica pays it).
         self.multiplexed = multiplexed
         self.model_load_s = float(model_load_s)
+        # fault injection for tests: loading any of these ids raises,
+        # exercising the router's load-failure route-around
+        self.model_load_fail_ids = set(model_load_fail_ids or ())
         from ray_tpu.core.config import GLOBAL_CONFIG as _gc
         from ray_tpu.serve.multiplex import _ModelCache
         self._models = _ModelCache(
@@ -123,6 +127,8 @@ class SimLLMServer:
 
     async def _load_model(self, model_id: str) -> Dict[str, Any]:
         await asyncio.sleep(self.model_load_s)
+        if model_id in self.model_load_fail_ids:
+            raise RuntimeError(f"injected load failure for {model_id!r}")
         with self._lock:
             self.metrics["model_loads"] += 1
         return {"model_id": model_id}

@@ -154,7 +154,7 @@ class LLMRouter:
         #: {pending, active, draining, busy, models, model_queue, ...}
         self._replica_stats: Dict[str, Dict[str, Any]] = {}
         self.counters = {"requests": 0, "shed": 0, "replica_shed": 0,
-                         "tenant_shed": 0,
+                         "replica_failed": 0, "tenant_shed": 0,
                          "reroutes": 0, "affinity_picks": 0,
                          "fallback_picks": 0, "warm_model_picks": 0,
                          "cold_model_picks": 0, "compiled_streams": 0,
@@ -496,11 +496,13 @@ class LLMRouter:
         emitted: List[int] = []
         avoid: set = set()
         attempts = 0
+        last_err: Optional[str] = None
         try:
             while True:
                 attempts += 1
                 if attempts > self.max_attempts:
-                    yield {"error": "no replica could finish the stream",
+                    yield {"error": last_err
+                                    or "no replica could finish the stream",
                            "status": 503, "done": True,
                            "n_tokens": len(emitted)}
                     return
@@ -508,7 +510,9 @@ class LLMRouter:
                     key, replica = await loop.run_in_executor(
                         self._executor, self._pick, prompt, model, avoid)
                 except RuntimeError as e:
-                    yield {"error": str(e), "status": 503, "done": True,
+                    yield {"error": (f"{e}; last replica error: {last_err}"
+                                     if last_err else str(e)),
+                           "status": 503, "done": True,
                            "n_tokens": len(emitted)}
                     return
                 sub = {"prompt": prompt + emitted,
@@ -546,6 +550,18 @@ class LLMRouter:
                             # route around it, do not fail the client
                             with self._lock:
                                 self.counters["replica_shed"] += 1
+                            avoid.add(key)
+                            rerouted = True
+                            break
+                        if isinstance(item, dict) and item.get("done") \
+                                and int(item.get("status") or 0) >= 500:
+                            # replica-side hard failure (e.g. cold-model
+                            # load failed): another replica may still
+                            # serve it — route around, fail the client
+                            # only when every attempt is spent
+                            with self._lock:
+                                self.counters["replica_failed"] += 1
+                            last_err = item.get("error")
                             avoid.add(key)
                             rerouted = True
                             break

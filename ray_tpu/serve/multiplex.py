@@ -80,6 +80,12 @@ class _ModelCache:
         self.unloader = unloader
         self.max_models = max_models
         self.cache: OrderedDict = OrderedDict()
+        # immutable membership snapshot, republished under the lock on
+        # every insert/evict: threads outside the event loop (the
+        # replica's decode loop) iterate THIS, never the live
+        # OrderedDict — get()'s move_to_end/popitem would otherwise race
+        # their iteration with "dict mutated during iteration"
+        self._values: tuple = ()
         self.loading: dict = {}   # model_id -> Future (in-flight dedup)
         self.lock = asyncio.Lock()
         self.name = name or f"cache-{next(_cache_seq)}"
@@ -93,6 +99,11 @@ class _ModelCache:
 
     def snapshot_items(self) -> List[Tuple[str, Any]]:
         return list(self.cache.items())
+
+    def values_snapshot(self) -> Tuple[Any, ...]:
+        """Loaded model objects as an immutable tuple — safe to iterate
+        from any thread while the event loop mutates the cache."""
+        return self._values
 
     def __contains__(self, model_id: str) -> bool:
         return model_id in self.cache
@@ -136,6 +147,7 @@ class _ModelCache:
             self.loading.pop(model_id, None)
             while len(self.cache) > self.max_models:
                 evicted.append(self.cache.popitem(last=False))
+            self._values = tuple(self.cache.values())
             self.load_count += 1
             _m_loaded.set(len(self.cache), tags=self._tags)
         for mid, obj in evicted:
@@ -149,6 +161,7 @@ class _ModelCache:
         async with self.lock:
             obj = self.cache.pop(model_id, None)
             if obj is not None:
+                self._values = tuple(self.cache.values())
                 _m_loaded.set(len(self.cache), tags=self._tags)
         if obj is None:
             return False

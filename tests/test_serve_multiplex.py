@@ -85,6 +85,8 @@ def test_model_cache_inflight_dedup():
         assert a is b
         assert calls == ["m0"]
         assert cache.models() == ["m0"]
+        # the cross-thread iteration snapshot tracks membership
+        assert cache.values_snapshot() == (a,)
         # a later get is a pure cache hit, no second load
         c = await cache.get(None, "m0")
         assert c is a and calls == ["m0"]
@@ -116,6 +118,7 @@ def test_model_cache_lru_eviction_order_under_touches():
         await cache.get(None, "b")          # overflow again: a untouched
         assert cache.models() == ["c", "b"]
         assert evicted == [("b", "b"), ("a", "a")]
+        assert [o["model"] for o in cache.values_snapshot()] == ["c", "b"]
         assert cache.eviction_count == 2
         # explicit unload also runs the hook and reports truthfully
         assert await cache.unload(None, "c") is True
@@ -282,6 +285,37 @@ def test_model_affinity_loads_each_model_once(ray_start_regular):
     rstats = ray_tpu.get(handle.method("stats").remote())
     assert rstats["warm_model_picks"] + rstats["cold_model_picks"] == reqs
     assert rstats["model_inflight"] == {}   # all drained
+    serve.shutdown()
+
+
+def test_cold_load_failure_routes_around_not_terminal(ray_start_regular):
+    """A replica's cold-model load failure (typed 503 done-frame) is
+    REROUTABLE, not terminal: the router walks every replica before
+    failing the client, and the final error surfaces the replica-side
+    cause. A healthy model on the same fleet still serves."""
+    app = build_llm_app(
+        use_sim=True, num_replicas=2, router_policy="affinity",
+        router_kwargs={"stats_interval_s": 0.2},
+        multiplexed=True, model_load_s=0.0, decode_s_per_token=0.001,
+        max_queue_depth=None, model_load_fail_ids=["m-bad"])
+    handle = serve.run(app)
+    toks, final = _consume(handle, {"prompt": [1, 2, 3],
+                                    "max_new_tokens": 2,
+                                    "model": "m-bad"})
+    assert toks == []
+    assert final and final["status"] == 503
+    assert "injected load failure" in final["error"]
+    rstats = ray_tpu.get(handle.method("stats").remote())
+    assert rstats["replica_failed"] == 2, (
+        "router must try BOTH replicas before failing the stream: "
+        f"{rstats}")
+    # the failure is contained to the bad id — a good model still loads
+    # and streams on the same fleet
+    toks, final = _consume(handle, {"prompt": [1, 2, 3],
+                                    "max_new_tokens": 2,
+                                    "model": "m-ok"})
+    assert final and final["done"] and final.get("status") != 429
+    assert len(toks) == 2
     serve.shutdown()
 
 
