@@ -1666,6 +1666,38 @@ def run_telemetry_bench(inc_iters: int = 50_000, flush_iters: int = 300,
     if workload_err:
         edges = dict(edges, error=workload_err)
 
+    # 4. raylint wall time: cold analysis vs warm result-cache run over
+    # the whole package, normalized per active rule so the cell stays
+    # comparable as the catalog grows
+    import os
+    import shutil
+    import tempfile
+
+    from ray_tpu.devtools.lint import all_rules, run_lint
+
+    lint_cache = tempfile.mkdtemp(prefix="raylint_bench_")
+    try:
+        pkg_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "ray_tpu")
+        t0 = time.perf_counter()
+        cold_rep = run_lint([pkg_dir], cache_dir=lint_cache)
+        lint_cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run_lint([pkg_dir], cache_dir=lint_cache)
+        lint_warm_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(lint_cache, ignore_errors=True)
+    n_rules = len(all_rules())
+    lint_cell = {
+        "files_scanned": cold_rep.files_scanned,
+        "rules": n_rules,
+        "cold_s": round(lint_cold_s, 3),
+        "warm_s": round(lint_warm_s, 3),
+        "cold_ms_per_rule": round(1000.0 * lint_cold_s / max(n_rules, 1), 2),
+        "warm_pct_of_cold": round(
+            100.0 * lint_warm_s / max(lint_cold_s, 1e-9), 1),
+    }
+
     ratio = batched_ops / max(flush_ops, 1e-9)
     result = {
         "metric": "telemetry_counter_inc_batched_vs_per_flush",
@@ -1683,6 +1715,7 @@ def run_telemetry_bench(inc_iters: int = 50_000, flush_iters: int = 300,
             "beacon_snapshot_s": snap_s,
             "watchdog_overhead_pct": round(watchdog_pct, 4),
             "edge_stats": edges,
+            "raylint_wall_time": lint_cell,
             "note": "per_flush emulates the pre-agent synchronous kv_put "
                     "per Counter.inc(); edge_stats should show populated "
                     "EWMA latency/bandwidth after the allreduce + pull",

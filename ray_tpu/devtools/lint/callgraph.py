@@ -239,6 +239,46 @@ class ProjectGraph:
                 out.setdefault(op, (nid, path, [op, line, col]))
         return out
 
+    def declared_axes(self) -> Dict[str, Tuple[str, int]]:
+        """{axis name: (declaring path, line)} over every file's SPMD
+        extract — module constants (AXIS_ORDER = (...)) plus in-function
+        mesh constructions (Mesh/make_mesh/MeshSpec/DCNSpec)."""
+        out: Dict[str, Tuple[str, int]] = {}
+        for fs in self.files:
+            for ax, line in (fs.spmd or {}).get("axis_decls", []):
+                out.setdefault(ax, (fs.path, line))
+            for f in fs.functions:
+                for ax, line in (f.spmd or {}).get("axis_decls", []):
+                    out.setdefault(ax, (fs.path, line))
+        return out
+
+    def linearize_events(self, module: str, cls: str, events: List[List],
+                         depth: Optional[int] = None,
+                         _seen: frozenset = frozenset()
+                         ) -> List[Tuple[str, str]]:
+        """Flatten an ordered SPMD event list into (op, axis-or-group)
+        tokens, inlining resolvable helper calls depth-first so the
+        result is the rank's actual rendezvous order. Depth-capped and
+        cycle-safe; unresolvable calls contribute nothing (conservative:
+        under-approximates, never invents an op)."""
+        cap = self.depth if depth is None else depth
+        out: List[Tuple[str, str]] = []
+        for ev in events:
+            if ev[0] == "op":
+                out.append((ev[1], ev[2]))
+                continue
+            callee = self.resolve_call(module, cls, ev[1])
+            if callee is None or callee in _seen or cap <= 0:
+                continue
+            cs = self.functions.get(callee)
+            if cs is None:
+                continue
+            out.extend(self.linearize_events(
+                callee.split(":", 1)[0], cs.cls,
+                (cs.spmd or {}).get("schedule", []),
+                cap - 1, _seen | {callee}))
+        return out
+
     def resolve_lock(self, module: str, cls: str, expr: str
                      ) -> Tuple[str, str]:
         """(lock key, kind) for an acquisition expression, ('', '') when

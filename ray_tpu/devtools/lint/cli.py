@@ -31,7 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "(default: ray_tpu)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the machine-readable report (stable "
-                             "schema, version 2) instead of text")
+                             "schema, version 3) instead of text")
     parser.add_argument("--changed-only", action="store_true",
                         help="limit to files changed vs git HEAD plus "
                              "untracked files (fast pre-commit mode); "

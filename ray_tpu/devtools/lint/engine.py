@@ -44,7 +44,8 @@ _GENERATED_MARKERS = ("_pb2.py", "_pb2_grpc.py")
 
 # Bump to force a cache flush even when no analyzer source changed
 # (e.g. a semantic change smuggled in via data files).
-RULESET_VERSION = 1
+# 2: SPMD plane — summaries carry mesh-axis/jit-boundary/schedule facts.
+RULESET_VERSION = 2
 
 DEFAULT_CACHE_DIR = ".raylint_cache"
 
@@ -123,8 +124,8 @@ class LintReport:
 
     @classmethod
     def from_dict(cls, doc: dict) -> "LintReport":
-        """Read back a --json report; accepts schema v1 and v2."""
-        if doc.get("version") not in (1, SCHEMA_VERSION):
+        """Read back a --json report; accepts schema v1, v2, and v3."""
+        if doc.get("version") not in (1, 2, SCHEMA_VERSION):
             raise ValueError(f"unknown raylint schema {doc.get('version')}")
         summary = doc.get("summary", {})
         rep = cls(

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 SEVERITIES = ("error", "warn")
 
 
@@ -18,6 +18,10 @@ class Finding:
     hint: str = ""      # how to fix it (one line)
     severity: str = "error"   # "error" | "warn"
     suppressed: bool = field(default=False)
+    # SPMD facts backing the finding (schema v3): e.g. the declared-axes
+    # set for mesh-axis-consistency, the per-arm schedule diff for
+    # collective-schedule-divergence. {} for rules with nothing to add.
+    spmd: dict = field(default_factory=dict)
 
     def render(self) -> str:
         tail = f"  [hint: {self.hint}]" if self.hint else ""
@@ -27,7 +31,7 @@ class Finding:
                 f"{self.message}{tail}{sup}")
 
     def to_dict(self) -> dict:
-        # Stable --json schema v2; tests/test_lint.py pins these keys.
+        # Stable --json schema v3; tests/test_lint.py pins these keys.
         return {
             "rule": self.rule,
             "path": self.path,
@@ -37,15 +41,18 @@ class Finding:
             "hint": self.hint,
             "severity": self.severity,
             "suppressed": self.suppressed,
+            "spmd": self.spmd,
         }
 
     @classmethod
     def from_dict(cls, doc: dict) -> "Finding":
         """Accepts v1 dicts (no severity field — everything was an
-        error) and v2; tooling reading old CI artifacts keeps working."""
+        error), v2 (no spmd facts), and v3; tooling reading old CI
+        artifacts keeps working."""
         return cls(
             rule=doc["rule"], path=doc["path"], line=doc["line"],
             col=doc["col"], message=doc["message"],
             hint=doc.get("hint", ""),
             severity=doc.get("severity", "error"),
-            suppressed=doc.get("suppressed", False))
+            suppressed=doc.get("suppressed", False),
+            spmd=doc.get("spmd", {}))

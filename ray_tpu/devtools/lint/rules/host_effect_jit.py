@@ -1,0 +1,91 @@
+"""host-effect-in-jit: host-side effects inside a jit-compiled body.
+
+A jitted function's Python body runs once, at trace time. A host
+effect written there — ``ray_tpu.get``, ``time.sleep``, a wall-clock
+read, a metrics RPC, a host collective — either executes exactly once
+and bakes its result into the compiled program (wall-clock reads,
+metric increments that silently stop counting) or turns every
+dispatch into a host round-trip that defeats the compilation entirely
+(blocking gets inside a shard_map). Both are bugs that CPU-backed
+tests cannot see: the trace executes eagerly there, so behavior only
+changes on a real TPU backend.
+
+Jit roots are functions carrying a jit/sharded_jit/shard_map decorator
+plus the resolvable targets of ``jax.jit(f)`` / ``shard_map(f, ...)``
+call sites. Reachability is deliberately shallow (depth 3): helpers
+called from a jitted body are usually device code, and the short
+horizon keeps a resolution mistake from spraying findings.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.lint.findings import Finding
+from ray_tpu.devtools.lint.registry import Rule, register
+
+_DEPTH = 3
+_BLOCK_WORDS = {"get": "blocking ray_tpu.get", "wait": "blocking wait",
+                "sleep": "time.sleep", "join": "thread join",
+                "cond-wait": "condition wait"}
+
+
+@register
+class HostEffectInJit(Rule):
+    id = "host-effect-in-jit"
+    doc = ("host-side effect (blocking get/wait/sleep, wall-clock read, "
+           "metric RPC, host collective) inside a jit-compiled body — "
+           "runs at trace time only, or blocks every dispatch")
+    hint = ("move the host effect outside the jitted function and pass "
+            "its result in as an argument (or return data to log)")
+    scope = "graph"
+
+    def _jit_roots(self, graph):
+        roots = {}
+        for nid, s in sorted(graph.functions.items()):
+            sp = s.spmd or {}
+            if sp.get("jit"):
+                roots.setdefault(nid, s.qualname)
+            module = nid.split(":", 1)[0]
+            for kind, target, _line, _ia, _oa in sp.get("jit_wraps", []):
+                callee = graph.resolve_call(module, s.cls, target)
+                if callee is not None and callee in graph.functions:
+                    roots.setdefault(
+                        callee, graph.functions[callee].qualname)
+        return roots
+
+    def check_graph(self, graph):
+        reported = set()
+        for root, root_name in sorted(self._jit_roots(graph).items()):
+            for nid, _path in graph.reach(root, depth=_DEPTH):
+                s = graph.functions.get(nid)
+                if s is None:
+                    continue
+                path = graph.fn_path.get(nid, "?")
+                inside = "" if nid == root else \
+                    f" (called from jitted {root_name})"
+                sites = []
+                for b in s.blocking:
+                    what = _BLOCK_WORDS.get(b["kind"], b["kind"])
+                    sites.append((b["line"], b["col"],
+                                  f"{what} ({b['name']})", b["kind"]))
+                for op, line, col in s.collectives:
+                    sites.append((line, col,
+                                  f"host collective {op}(...)",
+                                  "host-collective"))
+                for kind, name, line, col in (s.spmd or {}).get(
+                        "host_effects", []):
+                    what = ("wall-clock read" if kind == "wall-clock"
+                            else "metric RPC")
+                    sites.append((line, col, f"{what} ({name})", kind))
+                for line, col, what, kind in sites:
+                    key = (nid, line, col)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield Finding(
+                        rule=self.id, path=path, line=line, col=col,
+                        message=(f"{what} inside the jit-compiled body "
+                                 f"of {root_name}{inside} — executes at "
+                                 "trace time only (or blocks every "
+                                 "dispatch)"),
+                        hint=self.hint,
+                        spmd={"jit_root": root_name, "effect": kind})

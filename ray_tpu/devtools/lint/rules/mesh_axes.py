@@ -1,0 +1,64 @@
+"""mesh-axis-consistency: literal axis names must be declared somewhere.
+
+An axis name in a ``PartitionSpec``, an ``axis_name=`` kwarg, or a
+``lax.psum``-family call that no mesh in the project declares is almost
+always a typo — and JAX does not make it loud. ``logical_to_mesh``
+drops axes whose mesh size is 1 (``mesh.shape.get(axis, 1)``), so
+``P("fdsp")`` on an fsdp mesh silently *replicates* the tensor every
+rank instead of sharding it: no error, no speedup, 8x the HBM.
+
+The declared-axes universe is the union over the whole project —
+module constants like ``AXIS_ORDER = ("dp", "pp", ...)``, literal
+``Mesh(...)``/``make_mesh(...)`` axis tuples, and ``MeshSpec``/
+``DCNSpec`` keyword names. The rule stays silent when that universe is
+empty (a tree that never declares a mesh has nothing to check against),
+which also keeps single-file fixtures self-contained.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.lint.findings import Finding
+from ray_tpu.devtools.lint.registry import Rule, register
+
+_CTX_WORDS = {
+    "partition-spec": "PartitionSpec",
+    "axis-kwarg": "axis_name= kwarg",
+    "axis-default": "axis_name default",
+    "lax-collective": "lax collective",
+    "axis-query": "axis query",
+    "rules-value": "ShardingRules value",
+}
+
+
+@register
+class MeshAxisConsistency(Rule):
+    id = "mesh-axis-consistency"
+    doc = ("literal axis name not declared by any mesh/preset in the "
+           "project — unknown axes silently replicate instead of "
+           "sharding (mesh.shape treats them as size 1)")
+    hint = ("fix the axis-name typo, or declare the axis on a mesh "
+            "(AXIS_ORDER / Mesh(..., axis_names=...) / MeshSpec kwarg)")
+    scope = "graph"
+
+    def check_graph(self, graph):
+        declared = graph.declared_axes()
+        if not declared:
+            return
+        universe = sorted(declared)
+        for nid, s in sorted(graph.functions.items()):
+            path = graph.fn_path.get(nid, "?")
+            seen = set()
+            for ax, line, col, ctx in (s.spmd or {}).get("axis_uses", []):
+                if ax in declared or (ax, line, col) in seen:
+                    continue
+                seen.add((ax, line, col))
+                where = _CTX_WORDS.get(ctx, ctx)
+                yield Finding(
+                    rule=self.id, path=path, line=line, col=col,
+                    message=(f"axis {ax!r} in a {where} is not declared "
+                             f"by any mesh in the project (declared: "
+                             f"{', '.join(universe)}) — an unknown axis "
+                             "silently replicates instead of sharding"),
+                    hint=self.hint,
+                    spmd={"axis": ax, "context": ctx,
+                          "declared_axes": universe})

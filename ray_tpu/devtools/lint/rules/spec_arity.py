@@ -1,0 +1,92 @@
+"""spec-arity: shard_map in_specs/out_specs must match the wrapped fn.
+
+``shard_map`` zips ``in_specs`` against the wrapped function's
+positional arguments; a 3-spec tuple over a 2-argument function fails
+at trace time on TPU pods — long after the CI that ran on CPU passed,
+because the mismatch only trips once a real mesh is attached. The
+out_specs side is worse: a tuple out_specs against a non-tuple return
+(or the wrong tuple width) reshards garbage.
+
+Only literal tuple/list specs are checked (a variable or pytree-prefix
+spec is recorded as arity -1 and skipped), and functions taking
+``*args`` are exempt — the rule under-approximates rather than guess.
+Covers both the decorator form (``@sharded_jit(in_specs=...)`` on the
+function itself) and the call form (``jax.shard_map(f, in_specs=...)``)
+with the target resolved through the project call graph.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.lint.findings import Finding
+from ray_tpu.devtools.lint.registry import Rule, register
+
+
+def _pos_range(params):
+    """(min, max) acceptable spec count for a params record, accounting
+    for a leading self/cls; (None, None) when *args makes it unknowable."""
+    n_pos, n_required, has_varargs, first = params
+    if has_varargs:
+        return None, None
+    skip = 1 if first in ("self", "cls") else 0
+    return max(0, n_required - skip), max(0, n_pos - skip)
+
+
+@register
+class SpecArity(Rule):
+    id = "spec-arity"
+    doc = ("shard_map/sharded_jit in_specs arity disagrees with the "
+           "wrapped function's signature (or out_specs with its return "
+           "arity) — fails at trace time only once a real mesh attaches")
+    hint = ("give every mapped positional argument exactly one spec in "
+            "in_specs, and match out_specs to the returned tuple shape")
+    scope = "graph"
+
+    def check_graph(self, graph):
+        for nid, s in sorted(graph.functions.items()):
+            module = nid.split(":", 1)[0]
+            path = graph.fn_path.get(nid, "?")
+            sp = s.spmd or {}
+
+            jd = sp.get("jit")
+            if jd and jd.get("kind") in ("sharded_jit", "shard_map"):
+                yield from self._compare(
+                    path, jd["line"], f"@{jd['kind']} on {s.qualname}",
+                    jd.get("in_arity", -1), jd.get("out_arity", -1),
+                    sp.get("params"), sp.get("returns", -1))
+
+            for kind, target, line, in_a, out_a in sp.get("jit_wraps", []):
+                if in_a < 0 and out_a < 0:
+                    continue
+                callee = graph.resolve_call(module, s.cls, target)
+                if callee is None:
+                    continue
+                cs = graph.functions.get(callee)
+                if cs is None:
+                    continue
+                csp = cs.spmd or {}
+                yield from self._compare(
+                    path, line, f"{kind}({target}, ...)",
+                    in_a, out_a, csp.get("params"),
+                    csp.get("returns", -1))
+
+    def _compare(self, path, line, what, in_a, out_a, params, returns):
+        if params is None:
+            return
+        lo, hi = _pos_range(params)
+        facts = {"in_specs": in_a, "out_specs": out_a,
+                 "params": list(params), "returns": returns}
+        if in_a >= 0 and lo is not None and not (lo <= in_a <= hi):
+            takes = str(hi) if lo == hi else f"{lo}..{hi}"
+            yield Finding(
+                rule=self.id, path=path, line=line, col=0,
+                message=(f"{what}: in_specs has {in_a} spec(s) but the "
+                         f"wrapped function takes {takes} positional "
+                         "argument(s)"),
+                hint=self.hint, spmd=facts)
+        if out_a >= 0 and returns >= 0 and out_a != returns:
+            yield Finding(
+                rule=self.id, path=path, line=line, col=0,
+                message=(f"{what}: out_specs has {out_a} spec(s) but "
+                         f"the wrapped function returns a "
+                         f"{returns}-tuple"),
+                hint=self.hint, spmd=facts)

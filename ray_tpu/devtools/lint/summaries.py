@@ -50,6 +50,33 @@ _LOCK_CTORS = {
 }
 _THREAD_CTORS = {"threading.Thread", "Thread", "multiprocessing.Process",
                  "Process"}
+# --- SPMD plane tables ------------------------------------------------------
+# Device collectives emitted inside jitted/shard_map'd bodies. These are
+# rendezvous points exactly like the host ops above: every rank must
+# issue them in the same order.
+LAX_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                   "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                   "pswapaxes"}
+# Axis queries: not rendezvous ops, but their axis argument must name a
+# declared mesh axis all the same.
+LAX_AXIS_QUERIES = {"axis_index", "axis_size"}
+# Wall-clock reads: in a jitted body these execute once at trace time
+# and bake a constant into the compiled program.
+WALL_CLOCK = {"time.time", "time.perf_counter", "time.monotonic",
+              "time.time_ns", "datetime.now", "datetime.datetime.now",
+              "datetime.utcnow"}
+_METRIC_RECV_WORDS = ("metric", "counter", "gauge", "hist")
+# Host-collective calls that carry a group name, and which argument
+# position it rides in (kwarg `group_name=` always wins).
+HOST_GROUP_ARG = {
+    "allreduce": 1, "allgather": 1, "reducescatter": 1, "broadcast": 2,
+    "barrier": 0,
+    "allreduce_async": 1, "allgather_async": 1, "reducescatter_async": 1,
+    "broadcast_async": 2, "barrier_async": 0,
+    "init_collective_group": 2, "destroy_collective_group": 0,
+    "init_host_collective": 0, "destroy_host_collective": 0,
+}
+
 CHANNEL_OPS = {"execute", "teardown", "close", "put", "enqueue", "write",
                "experimental_compile",
                # KV-handoff lifecycle (serve/kv_transfer.py): exporters
@@ -71,6 +98,92 @@ def collective_op(call: ast.Call) -> str:
                                   for w in _COLLECTIVE_RECEIVERS):
         return ""
     return parts[-1]
+
+
+def _axis_strs(node: Optional[ast.AST]) -> List[str]:
+    """String literals in a Constant or Tuple/List/Set literal. Dynamic
+    expressions yield [] — the SPMD pass only reasons about literals."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _int_elems(node: Optional[ast.AST]) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)
+                and not isinstance(e.value, bool)]
+    return []
+
+
+def _spec_arity(node: Optional[ast.AST]) -> int:
+    """Arity of an in_specs/out_specs literal: len() for a tuple/list
+    literal, -1 for anything else (single spec, variable, pytree)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    return -1
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def jit_decorator(fn: ast.AST) -> Dict[str, Any]:
+    """Jit-boundary facts from a function's decorator stack, seeing
+    through ``functools.partial(jax.jit, ...)`` wrapping. {} if the
+    function is not jit/sharded_jit/shard_map decorated."""
+    for dec in getattr(fn, "decorator_list", []):
+        call = dec if isinstance(dec, ast.Call) else None
+        name = dotted_name(call.func if call else dec)
+        tail = name.split(".")[-1]
+        if tail == "partial" and call and call.args:
+            name = dotted_name(call.args[0])
+            tail = name.split(".")[-1]
+        if tail not in ("jit", "sharded_jit", "shard_map"):
+            continue
+        if tail == "jit" and not (name in ("jit", "jax.jit")
+                                  or name.endswith(".jit")):
+            continue
+        out = {"kind": tail, "line": dec.lineno, "in_arity": -1,
+               "out_arity": -1, "static_argnums": [], "donate_argnums": []}
+        for kw in (call.keywords if call else []):
+            if kw.arg == "in_specs":
+                out["in_arity"] = _spec_arity(kw.value)
+            elif kw.arg == "out_specs":
+                out["out_arity"] = _spec_arity(kw.value)
+            elif kw.arg == "static_argnums":
+                out["static_argnums"] = _int_elems(kw.value)
+            elif kw.arg == "donate_argnums":
+                out["donate_argnums"] = _int_elems(kw.value)
+        return out
+    return {}
+
+
+def _returns_arity(fn: ast.AST) -> int:
+    """Statically-known return arity: N when every return in the body
+    is a bare N-tuple literal, else -1 (unknown)."""
+    arity: Optional[int] = None
+    for node in walk_scope(fn):
+        if not isinstance(node, ast.Return):
+            continue
+        if not isinstance(node.value, ast.Tuple):
+            return -1
+        k = len(node.value.elts)
+        if arity is None:
+            arity = k
+        elif arity != k:
+            return -1
+    return -1 if arity is None else arity
 
 
 def mentions_rank(test: ast.AST) -> bool:
@@ -157,6 +270,21 @@ class FunctionSummary:
     lock_sections: List[Dict[str, Any]] = field(default_factory=list)
     channel_ops: List[Dict[str, Any]] = field(default_factory=list)
     local_types: Dict[str, str] = field(default_factory=dict)
+    # SPMD plane extract (all keys optional, omitted when empty):
+    #   jit            {kind,line,in_arity,out_arity,static_argnums,
+    #                   donate_argnums} — this fn is jit-decorated
+    #   jit_wraps      [[kind, target, line, in_arity, out_arity]] —
+    #                  jax.jit(f)/shard_map(f, ...) call sites in the body
+    #   axis_uses      [[axis, line, col, ctx]] — literal axis names
+    #   axis_decls     [[axis, line]] — mesh constructions declaring axes
+    #   schedule       ordered ["op",op,axis_or_group,ln,col] |
+    #                  ["call",name,ln,col] events outside rank branches
+    #   rank_scheds    [{line, arms: [events, events]}]
+    #   group_literals [[op, name, line, col]] — hardcoded group strings
+    #   host_effects   [[kind, name, line, col]] — wall-clock/metric calls
+    #   params         [n_pos, n_required, has_varargs, first_param]
+    #   returns        statically-known tuple return arity, -1 unknown
+    spmd: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -179,6 +307,9 @@ class FileSummary:
     imports: Dict[str, str] = field(default_factory=dict)
     module_types: Dict[str, str] = field(default_factory=dict)
     config: Dict[str, Any] = field(default_factory=dict)
+    # module-level SPMD facts: axis_decls [[axis, line]] from constants
+    # like AXIS_ORDER = ("dp", "pp", ...)
+    spmd: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> dict:
         return asdict(self)
@@ -188,7 +319,8 @@ class FileSummary:
         fs = cls(path=doc["path"], module=doc["module"],
                  imports=doc.get("imports", {}),
                  module_types=doc.get("module_types", {}),
-                 config=doc.get("config", {}))
+                 config=doc.get("config", {}),
+                 spmd=doc.get("spmd", {}))
         fs.functions = [FunctionSummary(**f) for f in doc.get("functions",
                                                               [])]
         fs.classes = [ClassSummary(**c) for c in doc.get("classes", [])]
@@ -222,9 +354,11 @@ class _FunctionExtractor:
     """Builds one FunctionSummary from an ast function node."""
 
     def __init__(self, fn: ast.AST, qualname: str, cls: str,
-                 is_actor: bool, bare_gets: Dict[str, str]):
+                 is_actor: bool, bare_gets: Dict[str, str],
+                 imports: Optional[Dict[str, str]] = None):
         self.fn = fn
         self.bare_gets = bare_gets
+        self.imports = imports or {}
         self.s = FunctionSummary(
             qualname=qualname, line=fn.lineno, cls=cls, is_actor=is_actor,
             is_async=isinstance(fn, ast.AsyncFunctionDef))
@@ -255,6 +389,7 @@ class _FunctionExtractor:
             elif isinstance(node, ast.Call):
                 self._call(node)
         self._channel_ops()
+        self._spmd()
         return s
 
     # -- pieces ----------------------------------------------------------
@@ -385,6 +520,238 @@ class _FunctionExtractor:
 
         visit_block(self.fn.body)
 
+    # -- SPMD plane ------------------------------------------------------
+    def _spmd(self) -> None:
+        """Populate FunctionSummary.spmd. Runs its own ordered traversal:
+        the main walk is BFS (ast.walk) which scrambles statement order,
+        and collective schedules are only meaningful linearized."""
+        fn, s = self.fn, self.s
+        sp: Dict[str, Any] = {}
+        a = fn.args
+        pos = [p.arg for p in list(getattr(a, "posonlyargs", [])) + a.args]
+        sp["params"] = [len(pos), len(pos) - len(a.defaults),
+                        1 if a.vararg else 0, pos[0] if pos else ""]
+        sp["returns"] = _returns_arity(fn)
+        jd = jit_decorator(fn)
+        if jd:
+            sp["jit"] = jd
+
+        uses: List[List[Any]] = []
+        decls: List[List[Any]] = []
+        wraps: List[List[Any]] = []
+        groups: List[List[Any]] = []
+        effects: List[List[Any]] = []
+        # def f(..., axis_name="sp"): the default is an axis use too
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            if p in ("axis_name", "axis_names") and d is not None:
+                for ax in _axis_strs(d):
+                    uses.append([ax, d.lineno, d.col_offset,
+                                 "axis-default"])
+
+        claimed: set = set()      # call nodes owned by rank-branch arms
+        rank_scheds: List[Dict[str, Any]] = []
+        for node in walk_scope(fn):
+            is_rank_if = isinstance(node, ast.If) \
+                and mentions_rank(node.test)
+            is_rank_ifexp = isinstance(node, ast.IfExp) \
+                and mentions_rank(node.test)
+            if not (is_rank_if or is_rank_ifexp):
+                continue
+            parts = ([node.body, node.orelse] if is_rank_if
+                     else [[node.body], [node.orelse]])
+            arms = []
+            for arm in parts:
+                arm_calls = [c for st in arm for c in ast.walk(st)
+                             if isinstance(c, ast.Call)]
+                claimed.update(id(c) for c in arm_calls)
+                arms.append(self._events(arm_calls))
+            rank_scheds.append({"line": node.lineno, "arms": arms})
+
+        all_calls = [n for n in walk_scope(fn)
+                     if isinstance(n, ast.Call)]
+        all_calls.sort(key=lambda c: (c.lineno, c.col_offset))
+        for c in all_calls:
+            self._spmd_call(c, uses, decls, wraps, groups, effects)
+        schedule = self._events([c for c in all_calls
+                                 if id(c) not in claimed])
+
+        if uses:
+            sp["axis_uses"] = uses
+        if decls:
+            sp["axis_decls"] = decls
+        if wraps:
+            sp["jit_wraps"] = wraps
+        if groups:
+            sp["group_literals"] = groups
+        if effects:
+            sp["host_effects"] = effects
+        if schedule:
+            sp["schedule"] = schedule
+        if rank_scheds:
+            sp["rank_scheds"] = rank_scheds
+        s.spmd = sp
+
+    def _events(self, calls: List[ast.Call]) -> List[List[Any]]:
+        out = []
+        for c in sorted(calls, key=lambda c: (c.lineno, c.col_offset)):
+            ev = self._event_for(c)
+            if ev:
+                out.append(ev)
+        return out
+
+    def _event_for(self, call: ast.Call) -> Optional[List[Any]]:
+        name = dotted_name(call.func)
+        op = collective_op(call)
+        if op:
+            return ["op", op, self._group_of(call, op),
+                    call.lineno, call.col_offset]
+        lax = self._lax_axes(call, name)
+        if lax is not None:
+            kind, axes = lax
+            return ["op", kind, ",".join(axes),
+                    call.lineno, call.col_offset]
+        if "?" in name:
+            return None
+        return ["call", name, call.lineno, call.col_offset]
+
+    def _lax_axes(self, call: ast.Call,
+                  name: str) -> Optional[Tuple[str, List[str]]]:
+        """(op, literal axes) when this is a jax.lax device collective,
+        else None. Bare names must be imported from jax.lax."""
+        parts = name.split(".")
+        tail = parts[-1]
+        if tail not in LAX_COLLECTIVES:
+            return None
+        if len(parts) > 1:
+            if "lax" not in parts[:-1]:
+                return None
+        elif "jax.lax" not in self.imports.get(tail, ""):
+            return None
+        node = _kwarg(call, "axis_name")
+        if node is None and len(call.args) > 1:
+            node = call.args[1]
+        return tail, _axis_strs(node)
+
+    def _group_of(self, call: ast.Call, op: str) -> str:
+        """Literal group name on a host-collective call, '' if dynamic."""
+        node = _kwarg(call, "group_name")
+        if node is None:
+            idx = HOST_GROUP_ARG.get(op, -1)
+            if 0 <= idx < len(call.args):
+                node = call.args[idx]
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return ""
+
+    def _collectiveish(self, parts: List[str], tail: str) -> bool:
+        if len(parts) == 1:
+            return tail in COLLECTIVE_OPS \
+                or "collective" in self.imports.get(tail, "")
+        return any(w in p for p in parts[:-1]
+                   for w in _COLLECTIVE_RECEIVERS)
+
+    def _spmd_call(self, call: ast.Call, uses, decls, wraps, groups,
+                   effects) -> None:
+        name = dotted_name(call.func)
+        parts = name.split(".")
+        tail = parts[-1]
+        ln, col = call.lineno, call.col_offset
+
+        # PartitionSpec("dp", ...) — bare aliases (P) resolve via imports
+        full = self.imports.get(tail, "") if len(parts) == 1 else name
+        if tail == "PartitionSpec" or full.endswith(".PartitionSpec"):
+            for argn in list(call.args) + [k.value for k in call.keywords]:
+                for ax in _axis_strs(argn):
+                    uses.append([ax, ln, col, "partition-spec"])
+
+        # axis_name=/axis_names= kwargs anywhere
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                for ax in _axis_strs(kw.value):
+                    uses.append([ax, ln, col, "axis-kwarg"])
+
+        # lax collectives / axis queries with a positional axis arg
+        if self._lax_axes(call, name) is not None:
+            if _kwarg(call, "axis_name") is None and len(call.args) > 1:
+                for ax in _axis_strs(call.args[1]):
+                    uses.append([ax, ln, col, "lax-collective"])
+        elif tail in LAX_AXIS_QUERIES \
+                and ("lax" in parts[:-1] or "jax" in parts[:-1]
+                     or (len(parts) == 1
+                         and "jax" in self.imports.get(tail, ""))):
+            if _kwarg(call, "axis_name") is None and call.args:
+                for ax in _axis_strs(call.args[0]):
+                    uses.append([ax, ln, col, "axis-query"])
+
+        # ShardingRules mesh-axis values: .with_(embed="fsdp") kwarg
+        # values, and the (("logical", ("mesh", ...)), ...) rule tables
+        if tail == "with_":
+            for kw in call.keywords:
+                for ax in _axis_strs(kw.value):
+                    uses.append([ax, ln, col, "rules-value"])
+        elif tail == "ShardingRules" or (tail == "cls"
+                                         and self.s.cls == "ShardingRules"):
+            for argn in call.args:
+                if isinstance(argn, (ast.Tuple, ast.List)):
+                    for e in argn.elts:
+                        if isinstance(e, (ast.Tuple, ast.List)) \
+                                and len(e.elts) == 2:
+                            for ax in _axis_strs(e.elts[1]):
+                                uses.append([ax, ln, col, "rules-value"])
+
+        # mesh constructions declare axes
+        if tail in ("MeshSpec", "DCNSpec"):
+            for kw in call.keywords:
+                if kw.arg:
+                    decls.append([kw.arg, ln])
+        elif tail in ("Mesh", "make_mesh"):
+            node = _kwarg(call, "axis_names")
+            if node is None and len(call.args) > 1:
+                node = call.args[1]
+            for ax in _axis_strs(node):
+                decls.append([ax, ln])
+
+        # jit wrap call sites: jax.jit(f) / shard_map(f, ...) /
+        # sharded_jit(f, ...) with a resolvable target
+        wrap_kind = ""
+        if tail == "shard_map" \
+                and ("jax" in parts[:-1]
+                     or (len(parts) == 1
+                         and "shard_map" in self.imports.get(tail, ""))):
+            wrap_kind = "shard_map"
+        elif tail == "jit" \
+                and ("jax" in parts[:-1]
+                     or (len(parts) == 1
+                         and self.imports.get(tail, "") == "jax.jit")):
+            wrap_kind = "jit"
+        elif tail == "sharded_jit":
+            wrap_kind = "sharded_jit"
+        if wrap_kind and call.args:
+            target = call.args[0]
+            if isinstance(target, (ast.Name, ast.Attribute)):
+                tname = dotted_name(target)
+                if "?" not in tname:
+                    wraps.append([wrap_kind, tname, ln,
+                                  _spec_arity(_kwarg(call, "in_specs")),
+                                  _spec_arity(_kwarg(call, "out_specs"))])
+
+        # hardcoded group names on host-collective calls
+        if tail in HOST_GROUP_ARG and self._collectiveish(parts, tail):
+            g = self._group_of(call, tail)
+            if g:
+                groups.append([tail, g, ln, col])
+
+        # host effects: wall-clock reads and metric RPCs
+        short = name[5:] if name.startswith("self.") else name
+        if name in WALL_CLOCK or short in WALL_CLOCK \
+                or (len(parts) == 1
+                    and self.imports.get(tail, "") in WALL_CLOCK):
+            effects.append(["wall-clock", name, ln, col])
+        elif tail in ("inc", "observe", "set") and len(parts) >= 2 \
+                and any(w in p.lower() for p in parts[:-1]
+                        for w in _METRIC_RECV_WORDS):
+            effects.append(["metric", name, ln, col])
+
 
 def _class_summary(node: ast.ClassDef, module: str) -> ClassSummary:
     cs = ClassSummary(name=node.name, line=node.lineno,
@@ -429,12 +796,26 @@ def summarize(tree: ast.Module, source: str, path: str) -> FileSummary:
                  if target in ("ray_tpu.get", "ray_tpu.wait")}
 
     for node in tree.body:
+        targets: List[ast.Name] = []
+        value: Optional[ast.AST] = None
         if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    tag = _ctor_tag(node.value)
-                    if tag:
-                        fs.module_types[t.id] = tag
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+            for t in targets:
+                tag = _ctor_tag(node.value)
+                if tag:
+                    fs.module_types[t.id] = tag
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            # AXIS_ORDER: Tuple[str, ...] = ("dp", ...) is an AnnAssign
+            targets, value = [node.target], node.value
+        for t in targets:
+            if ("axis" in t.id.lower() or "axes" in t.id.lower()) \
+                    and isinstance(value, (ast.Tuple, ast.List)):
+                for ax in _axis_strs(value):
+                    fs.spmd.setdefault("axis_decls", []).append(
+                        [ax, node.lineno])
 
     # parent map for qualnames
     parents: Dict[int, ast.AST] = {}
@@ -462,7 +843,7 @@ def summarize(tree: ast.Module, source: str, path: str) -> FileSummary:
         elif isinstance(node, FuncNode):
             qn, cls, is_actor = qualname_of(node)
             fs.functions.append(_FunctionExtractor(
-                node, qn, cls, is_actor, bare_gets).run())
+                node, qn, cls, is_actor, bare_gets, fs.imports).run())
 
     from ray_tpu.devtools.lint.rules.config_drift import extract_config
     fs.config = extract_config(tree, source, path)

@@ -1,0 +1,31 @@
+"""Negative: rank arms agree on the collective order.
+
+One arm routes through a helper and the other inlines the same
+sequence — the linearized schedules are identical, so every rank
+walks the rendezvous points in the same order. Rank-dependent
+*non-collective* work stays free, and device collectives outside any
+rank branch are straight-line SPMD code.
+"""
+
+import jax
+
+from ray_tpu import collective as col
+
+
+def _sync_then_fence(grads):
+    col.allreduce(grads, "grads")
+    col.barrier("grads")
+
+
+def finish_step(rank, grads, metrics):
+    if rank == 0:
+        metrics["steps"] = metrics.get("steps", 0) + 1   # rank-only work
+        _sync_then_fence(grads)
+    else:
+        col.allreduce(grads, "grads")
+        col.barrier("grads")
+
+
+def device_side(x):
+    y = jax.lax.psum(x, "dp")
+    return jax.lax.all_gather(y, "dp")

@@ -1,0 +1,24 @@
+"""Positive: rank arms reach the same collectives in different order.
+
+Both arms issue {allreduce, barrier} on the same group — so the
+set-based divergent-collective rule sees convergence and stays quiet —
+but rank 0 allreduces first (through a helper, exercising the
+interprocedural linearization) while everyone else barriers first.
+Rank 0 blocks in the allreduce rendezvous, the rest block in the
+barrier, and the whole gang wedges until the collective timeout.
+"""
+
+from ray_tpu import collective as col
+
+
+def _sync_grads(grads):
+    col.allreduce(grads, "grads")
+
+
+def finish_step(rank, grads):
+    if rank == 0:
+        _sync_grads(grads)          # allreduce, then barrier
+        col.barrier("grads")
+    else:
+        col.barrier("grads")        # barrier, then allreduce
+        col.allreduce(grads, "grads")

@@ -1,0 +1,29 @@
+"""Negative: re-form paths build group names dynamically.
+
+Names routed through collective.generation_name (or any variable /
+f-string) are invisible to the literal extract by construction, and
+literal names are fine on paths no elastic/re-form root reaches —
+static single-generation setup is exactly what a hardcoded name is
+for.
+"""
+
+from ray_tpu import collective as col
+from ray_tpu.collective import generation_name
+
+
+class ElasticGang:
+    def __init__(self, world_size, rank, base_group="train"):
+        self.world_size = world_size
+        self.rank = rank
+        self.base = base_group
+
+    def reform(self, generation):
+        name = generation_name(self.base, generation)
+        col.destroy_collective_group(name)
+        col.init_collective_group(self.world_size, self.rank, name)
+        col.barrier(f"{self.base}@fence{generation}")
+
+
+def static_setup(world_size, rank):
+    # never reached from an elastic root: a pinned name is correct here
+    col.init_collective_group(world_size, rank, "inference")

@@ -1,0 +1,24 @@
+"""Positive: literal group names on an elastic re-form path.
+
+After remediation the live group is generation-suffixed
+("train@g1", "train@g2", ...); these calls pin generation 0. The
+re-init site hardcodes "train" directly in the re-form method, and the
+barrier hides behind a helper the call graph has to walk to.
+"""
+
+from ray_tpu import collective as col
+
+
+def _fence_workers():
+    col.barrier("train")            # literal group, reached from reform
+
+
+class ElasticGang:
+    def __init__(self, world_size, rank):
+        self.world_size = world_size
+        self.rank = rank
+
+    def reform(self, generation):
+        col.destroy_collective_group("train")       # stale after gen 0
+        col.init_collective_group(self.world_size, self.rank, "train")
+        _fence_workers()

@@ -1,0 +1,42 @@
+"""Negative: jitted bodies are pure; host effects live outside them.
+
+Timing the compiled function from the caller, sleeping in the driver
+loop, and bumping metrics after device work completes are all correct
+placements — none of those functions is reachable from a jit root.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class _Counter:
+    def inc(self, n=1):
+        pass
+
+
+step_metric = _Counter()
+
+
+@jax.jit
+def train_step(params, batch):
+    return jnp.mean(batch) + params
+
+
+def _loss(params, batch):
+    return jnp.mean(batch) + params
+
+
+def make_fn():
+    return jax.jit(_loss)
+
+
+def driver_loop(params, batches):
+    fn = make_fn()
+    for batch in batches:
+        t0 = time.perf_counter()          # timing around the jit: fine
+        params = fn(params, batch)
+        step_metric.inc()                 # metric after device work
+        time.sleep(0.001)                 # host pacing in the driver
+    return params, time.perf_counter() - t0

@@ -1,0 +1,40 @@
+"""Positive: host-side effects inside jit-compiled bodies.
+
+The decorated step sleeps (blocks every dispatch — or worse, only at
+trace time); the wrapped compute reads the wall clock through a helper
+(the timestamp is traced once and baked into the compiled program, so
+every subsequent step logs the same "time"); the metrics counter
+increments during tracing only and then silently stops counting.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class _Counter:
+    def inc(self, n=1):
+        pass
+
+
+step_metric = _Counter()
+
+
+@jax.jit
+def train_step(params, batch):
+    time.sleep(0.01)                      # host block inside jit
+    step_metric.inc()                     # metric RPC inside jit
+    return jnp.mean(batch) + params
+
+
+def _stamp(x):
+    return x * time.time()                # wall-clock read
+
+
+def compute(x):
+    return _stamp(x) + 1.0
+
+
+def make_fn():
+    return jax.jit(compute)               # jit root via call form

@@ -1,0 +1,36 @@
+"""Negative: every literal axis name is declared by a mesh.
+
+Uses span all the contexts the extract records — PartitionSpec
+literals, axis_name kwargs, lax collectives, an axis-name default —
+and each one names an axis from AXIS_ORDER or the MeshSpec kwargs.
+Dynamic axis names (variables) are never checked.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+AXIS_ORDER = ("dp", "fsdp", "tp")
+
+
+def build():
+    spec = MeshSpec(dp=2, tp=4)
+    return Mesh(np.array(jax.devices()), ("dp", "tp")), spec
+
+
+def shard_params(params):
+    return jax.device_put(params, P(None, "fsdp"))
+
+
+def grad_sync(g, axis_name="dp"):
+    return jax.lax.psum(g, axis_name)
+
+
+def attention(q, k, v):
+    return jax.lax.all_gather(k, "tp"), jax.lax.axis_index("dp")
+
+
+def dynamic(x, axis):
+    return jax.lax.pmean(x, axis)   # variable axis: not checkable

@@ -1,0 +1,25 @@
+"""Positive: axis names nothing in the project declares.
+
+The mesh declares ("dp", "tp") — via the module constant and a literal
+Mesh construction — but the PartitionSpec says "fdsp" (a classic
+transposition of "fsdp") and the psum names "model", which no mesh
+axis matches. Both silently replicate at runtime.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS_ORDER = ("dp", "tp")
+
+
+def build():
+    return Mesh(np.array(jax.devices()), ("dp", "tp"))
+
+
+def shard_params(params):
+    return jax.device_put(params, P("fdsp"))        # typo: undeclared
+
+
+def grad_sync(g):
+    return jax.lax.psum(g, "model")                 # undeclared axis

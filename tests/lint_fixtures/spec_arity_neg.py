@@ -1,0 +1,56 @@
+"""Negative: spec arities line up, or are not statically checkable.
+
+Matching in/out arities stay clean; so do non-literal specs (a
+variable or single pytree-prefix spec records arity -1), functions
+taking *args, and defaulted trailing arguments whose spec may be
+omitted or supplied.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def two_arg(x, y):
+    return x + y
+
+
+def pair(x, y):
+    return x, y
+
+
+def with_default(x, scale=1.0):
+    return x * scale
+
+
+def matched(mesh, xs, ys):
+    f = jax.shard_map(pair, mesh=mesh,
+                      in_specs=(P("dp"), P("dp")),
+                      out_specs=(P(), P()))
+    return f(xs, ys)
+
+
+def single_spec(mesh, xs, ys):
+    # non-tuple specs: pytree prefix, applies to every leaf — arity -1
+    f = jax.shard_map(two_arg, mesh=mesh, in_specs=P("dp"),
+                      out_specs=P())
+    return f(xs, ys)
+
+
+def dynamic_specs(mesh, xs, ys, specs):
+    f = jax.shard_map(two_arg, mesh=mesh, in_specs=specs, out_specs=P())
+    return f(xs, ys)
+
+
+def defaulted(mesh, xs):
+    # 1 spec for (x, scale=1.0): within the required..total range
+    f = jax.shard_map(with_default, mesh=mesh, in_specs=(P("dp"),),
+                      out_specs=P())
+    return f(xs)
+
+
+def star_args(mesh, xs, ys):
+    def v(*tensors):
+        return sum(tensors)
+    g = jax.shard_map(v, mesh=mesh, in_specs=(P(), P(), P()),
+                      out_specs=P())
+    return g(xs, ys, ys)

@@ -1,0 +1,32 @@
+"""Positive: shard_map specs disagree with the wrapped function.
+
+`two_arg` takes two positional arguments but in_specs carries three
+specs; `pair` returns a 2-tuple but out_specs promises three. Both
+blow up at trace time — only once a real mesh is attached, i.e. on
+the pod, not in CPU CI.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def two_arg(x, y):
+    return x + y
+
+
+def pair(x, y):
+    return x, y
+
+
+def wrong_in(mesh, xs, ys):
+    f = jax.shard_map(two_arg, mesh=mesh,
+                      in_specs=(P("dp"), P(), P()),    # 3 specs, 2 args
+                      out_specs=P())
+    return f(xs, ys)
+
+
+def wrong_out(mesh, xs, ys):
+    f = jax.shard_map(pair, mesh=mesh,
+                      in_specs=(P("dp"), P("dp")),
+                      out_specs=(P(), P(), P()))       # 3 specs, 2-tuple
+    return f(xs, ys)

@@ -314,7 +314,7 @@ def test_cli_json_schema():
     assert proc.returncode == 1, proc.stderr  # unsuppressed findings
     doc = json.loads(proc.stdout)  # stdout is pure JSON...
     assert "RAYLINT" in proc.stderr  # ...summary one-liner on stderr
-    assert doc["version"] == 2
+    assert doc["version"] == 3
     summary = doc["summary"]
     for key in ("files_scanned", "files_skipped", "files_from_cache",
                 "parse_errors", "findings", "suppressed", "by_rule"):
@@ -323,12 +323,39 @@ def test_cli_json_schema():
     assert summary["by_rule"].get("leaked-object-ref", 0) >= 1
     for f in doc["findings"]:
         assert set(f) == {"rule", "path", "line", "col", "message",
-                          "hint", "severity", "suppressed"}
+                          "hint", "severity", "suppressed", "spmd"}
         assert f["severity"] in ("error", "warn")
         assert isinstance(f["line"], int) and isinstance(f["suppressed"], bool)
+        assert isinstance(f["spmd"], dict)
 
 
-def test_report_reads_v1_documents():
+def test_cli_json_carries_spmd_facts():
+    """v3 findings from the SPMD pack carry their backing facts: the
+    declared-axes universe for axis findings, the per-arm schedule diff
+    for divergence findings."""
+    proc = _run_cli("--json", "--rule", "mesh-axis-consistency",
+                    _fixture("mesh-axis-consistency", "pos"))
+    doc = json.loads(proc.stdout)
+    axes = [f for f in doc["findings"]
+            if f["rule"] == "mesh-axis-consistency"]
+    assert axes and all(
+        f["spmd"]["axis"] and f["spmd"]["declared_axes"] for f in axes)
+    assert axes[0]["spmd"]["declared_axes"] == ["dp", "tp"]
+
+    proc = _run_cli("--json", "--rule", "collective-schedule-divergence",
+                    _fixture("collective-schedule-divergence", "pos"))
+    doc = json.loads(proc.stdout)
+    div = [f for f in doc["findings"]
+           if f["rule"] == "collective-schedule-divergence"]
+    assert div
+    sp = div[0]["spmd"]
+    assert sp["schedule_true"] == [["allreduce", "grads"],
+                                   ["barrier", "grads"]]
+    assert sp["schedule_false"] == [["barrier", "grads"],
+                                    ["allreduce", "grads"]]
+
+
+def test_report_reads_v1_v2_documents():
     v1 = {"version": 1,
           "summary": {"files_scanned": 1, "findings": 1},
           "findings": [{"rule": "leaked-object-ref", "path": "x.py",
@@ -337,8 +364,16 @@ def test_report_reads_v1_documents():
     rep = LintReport.from_dict(v1)
     assert rep.findings[0].severity == "error"  # v1 default
     assert rep.findings[0].line == 3
-    rep2 = LintReport.from_dict(rep.to_dict())  # v2 round-trip
-    assert rep2.findings[0].severity == "error"
+    v2 = {"version": 2,
+          "summary": {"files_scanned": 1, "findings": 1},
+          "findings": [{"rule": "leaked-object-ref", "path": "x.py",
+                        "line": 3, "col": 4, "message": "m", "hint": "",
+                        "severity": "warn", "suppressed": False}]}
+    rep2 = LintReport.from_dict(v2)
+    assert rep2.findings[0].severity == "warn"
+    assert rep2.findings[0].spmd == {}          # v2 default
+    rep3 = LintReport.from_dict(rep2.to_dict())  # v3 round-trip
+    assert rep3.findings[0].severity == "warn"
 
 
 def test_cli_fail_on_threshold():
@@ -391,3 +426,256 @@ def test_cli_lint_subcommand():
     assert "RAYLINT" in clean.stdout
     dirty = ray_tpu_lint(_fixture("leaked-object-ref", "pos"))
     assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+
+
+# ---- SPMD plane: summary extract ------------------------------------------
+
+def _summary_of(src, name):
+    src = textwrap.dedent(src)
+    fs = summarize(ast.parse(src), src, "spmd_mod.py")
+    for f in fs.functions:
+        if f.qualname == name:
+            return f
+    raise AssertionError(f"no function {name!r} in summary")
+
+
+def test_spmd_axis_declarations():
+    src = """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from ray_tpu.parallel.mesh import MeshSpec
+
+        AXIS_ORDER: tuple = ("dp", "pp")    # AnnAssign form
+        EXTRA_AXES = ("sp",)                # plain Assign form
+
+        def build():
+            spec = MeshSpec(fsdp=4, tp=2)
+            return Mesh(np.array(jax.devices()), ("dp", "tp")), spec
+    """
+    src = textwrap.dedent(src)
+    fs = summarize(ast.parse(src), src, "axes_mod.py")
+    module_axes = {ax for ax, _ in fs.spmd["axis_decls"]}
+    assert module_axes == {"dp", "pp", "sp"}
+    g = ProjectGraph([fs])
+    # graph view unions module constants with in-function constructions
+    assert set(g.declared_axes()) == {"dp", "pp", "sp", "fsdp", "tp"}
+
+
+def test_spmd_jit_detection_through_decorator_stacking():
+    s = _summary_of("""
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1, 2),
+                           donate_argnums=(0,), inline=True)
+        def stacked(x, a, b):
+            return x
+    """, "stacked")
+    jd = s.spmd["jit"]
+    assert jd["kind"] == "jit"
+    assert jd["static_argnums"] == [1, 2]
+    assert jd["donate_argnums"] == [0]
+
+    s = _summary_of("""
+        from ray_tpu.parallel.presets import sharded_jit
+        from jax.sharding import PartitionSpec as P
+
+        @sharded_jit(in_specs=(P("dp"), P()), out_specs=P("dp"))
+        def step(state, batch):
+            return state
+    """, "step")
+    jd = s.spmd["jit"]
+    assert jd["kind"] == "sharded_jit"
+    assert jd["in_arity"] == 2
+    # single out spec: not a tuple literal, arity unknown
+    assert jd["out_arity"] == -1
+
+    s = _summary_of("""
+        import jax
+
+        def plain(x):
+            return x
+    """, "plain")
+    assert "jit" not in s.spmd
+
+
+def test_spmd_jit_wrap_call_sites():
+    s = _summary_of("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def local(a, b):
+            return a
+
+        def outer(mesh, xs):
+            f = jax.shard_map(local, mesh=mesh,
+                              in_specs=(P("dp"), P()), out_specs=P())
+            g = jax.jit(local)
+            h = jax.jit(lambda x: x)      # lambda target: not recorded
+            return f(xs, xs) + g(xs, xs)
+    """, "outer")
+    wraps = {(k, t, ia) for k, t, _ln, ia, _oa in s.spmd["jit_wraps"]}
+    assert wraps == {("shard_map", "local", 2), ("jit", "local", -1)}
+
+
+def test_spmd_schedule_linearization():
+    g = _graph({"sched": """
+        from ray_tpu import collective as col
+
+        def prep(x):
+            col.allreduce(x, "g")
+            finish(x)                 # nested helper: inlined too
+
+        def finish(x):
+            col.barrier("g")
+
+        def step(rank, x):
+            if rank == 0:
+                prep(x)
+            else:
+                col.allreduce(x, "g")
+                col.barrier("g")
+    """})
+    s = g.summary("sched:step")
+    arms = s.spmd["rank_scheds"][0]["arms"]
+    assert g.linearize_events("sched", "", arms[0]) == \
+        g.linearize_events("sched", "", arms[1]) == \
+        [("allreduce", "g"), ("barrier", "g")]
+
+    # cycles terminate, depth caps inlining
+    g2 = _graph({"loop": """
+        from ray_tpu import collective as col
+
+        def a(x):
+            col.barrier("g")
+            b(x)
+
+        def b(x):
+            a(x)
+    """})
+    sched = g2.summary("loop:a").spmd["schedule"]
+    assert g2.linearize_events("loop", "", sched) == \
+        [("barrier", "g"), ("barrier", "g")]
+
+
+def test_spmd_lax_collectives_in_schedule():
+    s = _summary_of("""
+        import jax
+
+        def device_step(x):
+            y = jax.lax.psum(x, "dp")
+            z = jax.lax.all_gather(y, "tp")
+            return z
+    """, "device_step")
+    ops = [(e[1], e[2]) for e in s.spmd["schedule"] if e[0] == "op"]
+    assert ops == [("psum", "dp"), ("all_gather", "tp")]
+
+
+# ---- SPMD plane: cache invalidation ---------------------------------------
+
+def test_spmd_extract_edit_invalidates_cache(tmp_path, monkeypatch):
+    """Editing the SPMD-extract source (summaries.py) must flush warm
+    cache entries — the fingerprint hashes the analyzer's own source,
+    not just RULESET_VERSION."""
+    import ray_tpu.devtools.lint.summaries as summaries_mod
+
+    p = tmp_path / "m.py"
+    p.write_text("def f():\n    return 1\n")
+    cache = str(tmp_path / "cache")
+    analyzed = []
+    real_analyze = lint_engine._analyze_file
+
+    def spy(pf, file_rules, need_summary):
+        analyzed.append(pf.path)
+        return real_analyze(pf, file_rules, need_summary)
+
+    monkeypatch.setattr(lint_engine, "_analyze_file", spy)
+    run_lint([str(p)], cache_dir=cache)
+    run_lint([str(p)], cache_dir=cache)
+    assert len(analyzed) == 1
+
+    fp_before = lint_engine.ruleset_fingerprint(all_rules())
+    real_getsource = lint_engine.inspect.getsource
+
+    def edited(obj):
+        src = real_getsource(obj)
+        if obj is summaries_mod:
+            return src + "\n# edited: schedule tokens gain a field\n"
+        return src
+
+    monkeypatch.setattr(lint_engine.inspect, "getsource", edited)
+    assert lint_engine.ruleset_fingerprint(all_rules()) != fp_before
+    rep = run_lint([str(p)], cache_dir=cache)
+    assert len(analyzed) == 2, "edited SPMD extract must re-analyze"
+    assert rep.files_from_cache == 0
+
+
+# ---- SPMD plane: injected defects against real tree sources ---------------
+
+def _inject(tmp_path, rel, replacements=()):
+    """Copy a real ray_tpu/ source into tmp with defects injected; the
+    anchors must exist so the test fails loudly if the tree drifts."""
+    with open(os.path.join(PKG, rel), encoding="utf-8") as fh:
+        src = fh.read()
+    for old, new in replacements:
+        assert old in src, f"injection anchor missing from {rel}: {old!r}"
+        src = src.replace(old, new)
+    dest = tmp_path / os.path.basename(rel)
+    dest.write_text(src)
+    return str(dest)
+
+
+def _rule(rule_id):
+    return next(r for r in all_rules() if r.id == rule_id)
+
+
+def test_injected_axis_typo_in_partition_spec_is_caught(tmp_path):
+    _inject(tmp_path, os.path.join("parallel", "mesh.py"))  # AXIS_ORDER
+    _inject(tmp_path, os.path.join("models", "llama.py"),
+            [('P(None, "sp")', 'P(None, "spp")')])
+    rep = run_lint([str(tmp_path)], rules=[_rule("mesh-axis-consistency")])
+    hits = [f for f in rep.unsuppressed
+            if f.rule == "mesh-axis-consistency"]
+    assert hits and all(f.spmd["axis"] == "spp" for f in hits)
+    assert "sp" in hits[0].spmd["declared_axes"]
+
+
+def test_injected_psum_order_mismatch_is_caught(tmp_path):
+    p = tmp_path / "ddstep.py"
+    p.write_text(textwrap.dedent("""\
+        import jax
+
+        def _gather_then_sum(x):
+            y = jax.lax.all_gather(x, "dp")
+            return jax.lax.psum(y, "dp")
+
+        def step(rank, x):
+            if rank == 0:
+                y = jax.lax.psum(x, "dp")
+                out = jax.lax.all_gather(y, "dp")
+            else:
+                out = _gather_then_sum(x)
+            return out
+    """))
+    rep = run_lint([str(p)],
+                   rules=[_rule("collective-schedule-divergence")])
+    hits = [f for f in rep.unsuppressed
+            if f.rule == "collective-schedule-divergence"]
+    assert hits
+    assert hits[0].spmd["schedule_true"] == [["psum", "dp"],
+                                             ["all_gather", "dp"]]
+    assert hits[0].spmd["schedule_false"] == [["all_gather", "dp"],
+                                              ["psum", "dp"]]
+
+
+def test_injected_hardcoded_group_on_elastic_path_is_caught(tmp_path):
+    _inject(tmp_path, os.path.join("train", "elastic.py"),
+            [('group.init_host_collective(group_name=col_group)',
+              'group.init_host_collective(group_name="train")')])
+    rep = run_lint([str(tmp_path)], rules=[_rule("hardcoded-group-name")])
+    hits = [f for f in rep.unsuppressed
+            if f.rule == "hardcoded-group-name"]
+    assert hits and hits[0].spmd["group"] == "train"
